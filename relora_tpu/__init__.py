@@ -1,0 +1,25 @@
+"""relora-tpu: a TPU-native (JAX/XLA/pjit/pallas) ReLoRA pretraining framework.
+
+Re-designed from scratch with the capabilities of the reference implementation
+(Guitaricet/relora, arXiv:2307.05695): parameter-efficient pretraining through
+repeated low-rank updates that are periodically merged into frozen full-rank
+weights, with synchronized optimizer-state resets and a cosine-with-restarts
+learning-rate schedule.
+
+Unlike the PyTorch reference (DDP/NCCL, in-place module surgery), everything
+here is functional and compiler-first:
+
+- models are Flax modules whose LoRA factors are ordinary pytree leaves
+  (``relora_tpu.models``),
+- merge-and-reinit is a pure jitted ``params -> params`` update
+  (``relora_tpu.core.relora``),
+- schedules and optimizer resets are pure optax-style transforms
+  (``relora_tpu.core.schedules``, ``relora_tpu.core.optim``),
+- parallelism is a ``jax.sharding.Mesh`` + NamedSharding over
+  ``('data', 'fsdp', 'tensor', 'sequence')`` axes (``relora_tpu.parallel``),
+- the data stack mirrors the reference's two pipelines: HF
+  pretokenize-and-chunk and a Megatron-style mmap indexed dataset with a C++
+  index builder (``relora_tpu.data``).
+"""
+
+__version__ = "0.1.0"

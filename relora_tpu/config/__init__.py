@@ -1,0 +1,2 @@
+from relora_tpu.config.model import ModelConfig, MODEL_ZOO, load_model_config
+from relora_tpu.config.training import TrainingConfig, parse_train_args

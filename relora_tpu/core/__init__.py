@@ -1,0 +1,19 @@
+from relora_tpu.core.schedules import (
+    linear_with_warmup,
+    cyclical_cosine_with_min_lr,
+    cosine_with_restarts,
+    make_schedule,
+)
+from relora_tpu.core.optim import (
+    build_optimizer,
+    lora_label_tree,
+    reset_optimizer_state,
+    zeroed_fraction,
+)
+from relora_tpu.core.relora import (
+    LoraSpec,
+    is_lora_path,
+    merge_and_reinit,
+    lora_param_mask,
+    split_param_counts,
+)

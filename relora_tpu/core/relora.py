@@ -1,0 +1,219 @@
+"""ReLoRA core: LoRA leaf classification and the pure merge-and-reinit update.
+
+The reference mutates modules in place: ``ReLoRaLinear.merge_and_reinit``
+does ``W += B @ A * scale`` then re-draws A (kaiming) and zeroes B under
+``torch.no_grad`` (peft_pretraining/relora.py:269-307).  Here the same
+operation is a **pure function** ``(params, rng) -> params``: the pytree
+structure, dtypes and shardings are unchanged, so the already-compiled train
+step keeps running after a merge with no retrace, and under a sharded mesh the
+merge is just a (fully sharded) pytree update — the thing that made the
+reference give up on FSDP (torchrun_main.py:611-613) is free by construction.
+
+Naming convention (see relora_tpu.models.lora.LoRALinear): a LoRA-wrapped
+Dense owns leaves ``kernel`` (frozen base), ``lora_a`` (in, r),
+``lora_b`` (r, out) and optionally ``lora_s`` (trainable scaling).  A module
+dict that contains ``lora_a`` marks its sibling ``kernel`` as frozen.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+LORA_A = "lora_a"
+LORA_B = "lora_b"
+LORA_S = "lora_s"
+
+
+@dataclass(frozen=True)
+class LoraSpec:
+    """Static LoRA hyperparameters needed by merge/init math.
+
+    Parity: ReLoRaConfig (relora.py:18-28) minus torch-specific fields.
+    """
+
+    r: int
+    alpha: float = 32.0
+    dropout: float = 0.1
+    trainable_scaling: bool = False
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.r
+
+
+def kaiming_uniform(key: jax.Array, shape: Tuple[int, ...], dtype=jnp.float32) -> jax.Array:
+    """torch's kaiming_uniform_(a=sqrt(5)) on a (out, in) weight = U(±1/sqrt(fan_in)).
+
+    Our lora_a is stored (in, r) (flax kernel convention), so fan_in is
+    shape[0].  Matches nn.init.kaiming_uniform_(lora_A.weight, a=math.sqrt(5))
+    at relora.py:251, 303.
+    """
+    bound = 1.0 / math.sqrt(shape[0])
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def is_lora_path(path: Tuple) -> bool:
+    """True if a tree path (from tree_map_with_path / tree_flatten_with_path)
+    addresses a LoRA factor leaf (parity: the reference's "lora_" name match,
+    torchrun_main.py:632)."""
+    if not path:
+        return False
+    last = path[-1]
+    name = getattr(last, "key", None) or getattr(last, "name", None) or str(last)
+    return str(name).startswith("lora_")
+
+
+def lora_param_mask(params: PyTree) -> PyTree:
+    """Boolean pytree: True for LoRA factor leaves (lora_a/lora_b/lora_s)."""
+    return jax.tree_util.tree_map_with_path(lambda p, _: is_lora_path(p), params)
+
+
+def frozen_param_mask(params: PyTree) -> PyTree:
+    """Boolean pytree: True for the frozen base kernels of LoRA-wrapped Denses.
+
+    A ``kernel`` (or ``bias``-less quantized variants) is frozen iff its module
+    dict also carries ``lora_a`` — mirroring ReLoRaLinear freezing only
+    ``self.weight`` (relora.py:259-261) while biases stay trainable.
+    """
+
+    def walk(node):
+        if isinstance(node, dict):
+            has_lora = LORA_A in node
+            out = {}
+            for k, v in node.items():
+                if isinstance(v, dict):
+                    out[k] = walk(v)
+                else:
+                    out[k] = bool(has_lora and k == "kernel")
+            return out
+        return False
+
+    return walk(params)
+
+
+def trainable_param_mask(params: PyTree, lora_only: bool = False) -> PyTree:
+    """True for every trainable leaf.
+
+    Reference semantics (torchrun_main.py:631-633): everything with
+    requires_grad — i.e. all params except the frozen base kernels.  With
+    ``lora_only`` only the LoRA factors train.
+    """
+    if lora_only:
+        return lora_param_mask(params)
+    frozen = frozen_param_mask(params)
+    return jax.tree_util.tree_map(lambda f: not f, frozen)
+
+
+def split_param_counts(params: PyTree) -> dict:
+    """Param accounting for logging (parity: torchrun_main.py:585-594)."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    total = trainable = lora = 0
+    frozen_mask_leaves = jax.tree_util.tree_leaves(frozen_param_mask(params))
+    for (path, leaf), is_frozen in zip(leaves_with_paths, frozen_mask_leaves):
+        n = leaf.size
+        total += n
+        if is_lora_path(path):
+            lora += n
+            trainable += n
+        elif not is_frozen:
+            trainable += n
+    return {
+        "total_params": total,
+        "trainable_params": trainable,
+        "lora_params": lora,
+        "equivalent_params": total - lora,  # params of the merged (base) model
+    }
+
+
+def _effective_scale(module: dict, spec: LoraSpec):
+    if spec.trainable_scaling and LORA_S in module:
+        # parity: trainable scaling passes through tanh (relora.py:263-267)
+        return jnp.tanh(module[LORA_S].astype(jnp.float32))
+    return spec.scale
+
+
+def lora_delta(module: dict, spec: LoraSpec) -> jax.Array:
+    """The full-rank update this module's factors currently represent:
+    ``lora_a @ lora_b * scale``, shaped like ``kernel``.
+
+    Computed at HIGHEST matmul precision: on TPU, f32 matmuls default to
+    bf16 MXU passes, and merge error would otherwise compound across every
+    ReLoRA cycle.  This matmul runs once per ``relora`` steps, so the extra
+    MXU passes are free in the training budget.
+    """
+    a = module[LORA_A].astype(jnp.float32)
+    b = module[LORA_B].astype(jnp.float32)
+    delta = jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+    return delta * _effective_scale(module, spec)
+
+
+def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
+    """Pure ReLoRA reset: fold every module's ``A @ B * scale`` into its frozen
+    kernel, re-draw A (kaiming uniform), zero B (and scaling, if trainable).
+
+    Parity: ReLoRaLinear.merge_and_reinit (relora.py:269-307) /
+    merge_and_reinit_functional (relora.py:31-46), but jit-safe: accepts and
+    returns the same pytree, merge math in f32, outputs cast back to stored
+    dtypes.  Intended use::
+
+        merged = jax.jit(partial(merge_and_reinit, spec=spec), donate_argnums=0)(params, rng)
+    """
+    # Deterministic per-module keys: count lora modules in tree order first.
+    modules = []
+
+    def collect(node):
+        if isinstance(node, dict):
+            if LORA_A in node:
+                modules.append(True)
+            for v in node.values():
+                collect(v)
+
+    collect(params)
+    keys = jax.random.split(rng, max(1, len(modules)))
+    key_iter = iter(range(len(modules)))
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if LORA_A not in node:
+            return {k: walk(v) for k, v in node.items()}
+        key = keys[next(key_iter)]
+        out = dict(node)
+        kernel = node["kernel"]
+        merged = kernel.astype(jnp.float32) + lora_delta(node, spec)
+        out["kernel"] = merged.astype(kernel.dtype)
+        out[LORA_A] = kaiming_uniform(key, node[LORA_A].shape).astype(node[LORA_A].dtype)
+        out[LORA_B] = jnp.zeros_like(node[LORA_B])
+        if spec.trainable_scaling and LORA_S in node:
+            out[LORA_S] = jnp.zeros_like(node[LORA_S])
+        return out
+
+    return walk(params)
+
+
+def merged_params(params: PyTree, spec: LoraSpec) -> PyTree:
+    """Merge without reinit: returns params of the equivalent full-rank model
+    (for export / saving an HF-compatible checkpoint), LoRA leaves dropped."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if LORA_A not in node:
+            return {k: walk(v) for k, v in node.items()}
+        out = {
+            k: v
+            for k, v in node.items()
+            if k not in (LORA_A, LORA_B, LORA_S)
+        }
+        kernel = node["kernel"]
+        out["kernel"] = (kernel.astype(jnp.float32) + lora_delta(node, spec)).astype(kernel.dtype)
+        return out
+
+    return walk(params)

@@ -1,0 +1,175 @@
+"""Learning-rate schedules as pure jnp functions of the update step.
+
+The reference implements these as torch ``LambdaLR`` lambdas
+(peft_pretraining/training_utils.py:173-236).  They are pure math, so here
+they become optax-compatible schedules — callables ``step -> lr`` built from
+``jnp.where`` so they can live inside a jitted train step (no Python control
+flow on traced values).
+
+Semantics match the reference exactly, including its quirks:
+
+- ``cyclical_cosine``: on later cycles the first two warmup steps return the
+  tiny constant 1e-7 (training_utils.py:179-183).
+- ``cosine_restarts``: after the first warmup, every ``restart_every`` steps
+  the LR is re-warmed over ``restart_warmup_steps`` up to the *decayed cosine
+  envelope* value, with ``adjust_step`` phase-shifting the restart grid to
+  sync with a warm-started checkpoint (training_utils.py:191-236).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def linear_with_warmup(peak_lr: float, warmup_steps: int, num_training_steps: int) -> Schedule:
+    """HF-style linear warmup then linear decay to 0 (training_utils.py:71-77)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / max(1, warmup_steps)
+        decay = jnp.maximum(
+            0.0,
+            (num_training_steps - step) / max(1, num_training_steps - warmup_steps),
+        )
+        return peak_lr * jnp.where(step < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def cyclical_cosine_with_min_lr(
+    peak_lr: float,
+    warmup_steps: int,
+    num_training_steps: int,
+    cycle_length: Optional[int],
+    min_lr_ratio: float = 0.1,
+) -> Schedule:
+    """Cyclical cosine with a min-LR floor (training_utils.py:103-118, 173-188)."""
+    if cycle_length is None:
+        cycle_length = num_training_steps
+    if num_training_steps % cycle_length != 0:
+        raise ValueError(
+            f"num_training_steps ({num_training_steps}) must be divisible by "
+            f"cycle_length ({cycle_length})"
+        )
+    if not 0 < min_lr_ratio <= 1.0:
+        raise ValueError("min_lr_ratio must be in (0, 1]")
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        cycle_step = jnp.mod(step, cycle_length)
+        # Later cycles: first 2 warmup steps pinned to 1e-7 (reference quirk).
+        warm = jnp.where(
+            (step != cycle_step) & (cycle_step < 2),
+            1e-7,
+            cycle_step / max(1, warmup_steps),
+        )
+        progress = (cycle_step - warmup_steps) / max(1, cycle_length - warmup_steps)
+        cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        decayed = min_lr_ratio + (1.0 - min_lr_ratio) * cosine
+        return peak_lr * jnp.where(cycle_step < warmup_steps, warm, decayed)
+
+    return schedule
+
+
+def cosine_with_restarts(
+    peak_lr: float,
+    first_warmup_steps: int,
+    restart_warmup_steps: int,
+    restart_every: int,
+    num_training_steps: int,
+    min_lr_ratio: float = 0.1,
+    adjust_step: int = 0,
+) -> Schedule:
+    """Cosine decay with periodic re-warmups to the decayed envelope.
+
+    This is the schedule ReLoRA couples to merge-and-reinit: each restart the
+    LR ramps from 0 to the value the cosine envelope would have at the end of
+    that warmup, then rejoins the global decay
+    (training_utils.py:121-147, 191-236).
+    """
+    if restart_every is None:
+        raise ValueError("restart_every (cycle_length) must be set for cosine_restarts")
+    if restart_every <= 0:
+        raise ValueError("restart_every must be positive")
+    if num_training_steps % restart_every != 0:
+        raise ValueError(
+            f"num_training_steps ({num_training_steps}) must be divisible by "
+            f"restart_every ({restart_every})"
+        )
+    if not 0 < min_lr_ratio <= 1.0:
+        raise ValueError("min_lr_ratio must be in (0, 1]")
+    if adjust_step + first_warmup_steps > num_training_steps:
+        raise ValueError("warmup + adjust_step exceeds total training steps")
+    if adjust_step + first_warmup_steps > restart_every:
+        raise ValueError("the first restart would fire before the first warmup is done")
+
+    denom = max(1, num_training_steps - first_warmup_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        first_warm = step / max(1, first_warmup_steps)
+
+        s = step + adjust_step
+        restart_step = jnp.mod(s, restart_every)
+        restart_number = jnp.floor_divide(s, restart_every)
+
+        # LR target at the end of this restart's warmup: the global envelope
+        # evaluated at (restart boundary + restart_warmup_steps).
+        end_of_warmup_progress = (
+            restart_number * restart_every + restart_warmup_steps - first_warmup_steps
+        ) / denom
+        envelope = min_lr_ratio + (1.0 - min_lr_ratio) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * end_of_warmup_progress)
+        )
+        rewarm = restart_step / max(1, restart_warmup_steps) * envelope
+
+        progress = (s - first_warmup_steps) / denom
+        decayed = min_lr_ratio + (1.0 - min_lr_ratio) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * progress)
+        )
+
+        in_rewarm = (restart_step < restart_warmup_steps) & (step >= restart_every)
+        value = jnp.where(in_rewarm, rewarm, decayed)
+        return peak_lr * jnp.where(step < first_warmup_steps, first_warm, value)
+
+    return schedule
+
+
+def make_schedule(
+    scheduler_type: str,
+    *,
+    lr: float,
+    num_training_steps: int,
+    warmup_steps: int,
+    min_lr_ratio: float = 0.1,
+    cycle_length: Optional[int] = None,
+    restart_warmup_steps: Optional[int] = None,
+    adjust_step: int = 0,
+) -> Schedule:
+    """Factory with the reference's dispatch semantics (training_utils.py:56-100)."""
+    if adjust_step != 0 and scheduler_type != "cosine_restarts":
+        raise ValueError("adjust_step is only supported for cosine_restarts")
+    if scheduler_type == "linear":
+        return linear_with_warmup(lr, warmup_steps, num_training_steps)
+    if scheduler_type == "cosine":
+        return cyclical_cosine_with_min_lr(
+            lr, warmup_steps, num_training_steps, cycle_length, min_lr_ratio
+        )
+    if scheduler_type == "cosine_restarts":
+        if restart_warmup_steps is None:
+            raise ValueError("restart_warmup_steps must be set for cosine_restarts")
+        return cosine_with_restarts(
+            lr,
+            first_warmup_steps=warmup_steps,
+            restart_warmup_steps=restart_warmup_steps,
+            restart_every=cycle_length,
+            num_training_steps=num_training_steps,
+            min_lr_ratio=min_lr_ratio,
+            adjust_step=adjust_step,
+        )
+    raise NotImplementedError(f"Scheduler {scheduler_type!r} is not implemented")

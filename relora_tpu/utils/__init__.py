@@ -1,0 +1,1 @@
+from relora_tpu.utils.logging import get_logger, metrics_logger, set_process_index

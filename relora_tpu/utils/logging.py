@@ -1,0 +1,158 @@
+"""Process-0-aware logging and a metrics channel with an optional wandb backend.
+
+The reference logs through loguru (console, rank 0 only — torchrun_main.py:371)
+and wandb (torchrun_main.py:404-419, 918-943).  Neither package is a hard
+dependency here: we use stdlib logging configured to be silent on non-zero
+processes, and a `MetricsLogger` that writes JSONL locally and forwards to
+wandb when it is importable and enabled.  The wandb metric schema (loss, lr,
+update_step, tokens_seen, throughput_tokens/examples/batches, n_lora_restarts,
+n_optimizer_resets) is preserved so dashboards port over unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Mapping, Optional
+
+_LOGGERS: dict[str, logging.Logger] = {}
+
+# Set by the trainer right after jax.distributed.initialize(); must NOT be
+# derived by calling into jax at import time — jax.process_index() initializes
+# the XLA backend, which would make a later jax.distributed.initialize() on a
+# multi-host launcher raise.
+_PROCESS_INDEX: Optional[int] = None
+
+
+def set_process_index(index: int) -> None:
+    """Record this host's process index; non-zero hosts stop emitting INFO
+    (parity: logger.remove() on nonzero ranks, torchrun_main.py:371)."""
+    global _PROCESS_INDEX
+    _PROCESS_INDEX = index
+
+
+def _process_index() -> int:
+    if _PROCESS_INDEX is not None:
+        return _PROCESS_INDEX
+    return int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+
+
+class _Process0Filter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        return _process_index() == 0 or record.levelno >= logging.ERROR
+
+
+def get_logger(name: str = "relora_tpu") -> logging.Logger:
+    """Stdlib logger that only emits on process 0, evaluated lazily at log
+    time so importing this module never touches jax."""
+    if name in _LOGGERS:
+        return _LOGGERS[name]
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s | %(levelname)-7s | %(name)s:%(lineno)d | %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.addFilter(_Process0Filter())
+        logger.propagate = False
+    _LOGGERS[name] = logger
+    return logger
+
+
+class MetricsLogger:
+    """Metrics sink: JSONL file always, wandb when available.
+
+    Mirrors the reference's wandb usage: ``log(dict, step=global_step)``
+    (torchrun_main.py:924-936), run-config capture (:639-655), and alerts
+    (training_utils.py:397-404).
+    """
+
+    def __init__(
+        self,
+        run_dir: Optional[str] = None,
+        project: str = "relora_tpu",
+        run_name: Optional[str] = None,
+        config: Optional[Mapping[str, Any]] = None,
+        use_wandb: bool = False,
+        resume_id: Optional[str] = None,
+    ):
+        self.enabled = _process_index() == 0
+        self.run_name = run_name
+        self.run_id = resume_id
+        self._fh = None
+        self._wandb = None
+        if not self.enabled:
+            return
+        if run_dir is not None:
+            os.makedirs(run_dir, exist_ok=True)
+            self._fh = open(os.path.join(run_dir, "metrics.jsonl"), "a")
+        if use_wandb:
+            try:
+                import wandb  # type: ignore
+
+                run = wandb.init(
+                    project=project,
+                    name=run_name,
+                    config=dict(config) if config else None,
+                    id=resume_id,
+                    resume="allow" if resume_id else None,
+                )
+                self._wandb = wandb
+                self.run_id = run.id
+                self.run_name = run.name
+            except Exception as e:  # wandb not installed / offline
+                get_logger().warning(f"wandb unavailable ({e}); metrics go to JSONL only")
+
+    def log(self, metrics: Mapping[str, Any], step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        record = {k: _to_scalar(v) for k, v in metrics.items()}
+        if step is not None:
+            record["_step"] = step
+        record["_time"] = time.time()
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if self._wandb is not None:
+            self._wandb.log(dict(metrics), step=step)
+
+    def alert(self, title: str, text: str) -> None:
+        """Parity: wandb.alert on bad post-reset LR (training_utils.py:397-404)."""
+        get_logger().warning(f"ALERT [{title}]: {text}")
+        if self._wandb is not None:
+            try:
+                self._wandb.alert(title=title, text=text)
+            except Exception:
+                pass
+
+    def finish(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def _to_scalar(v: Any) -> Any:
+    try:
+        import numpy as np
+
+        if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+            return v.item()
+        if isinstance(v, (np.floating, np.integer)):
+            return v.item()
+    except Exception:
+        pass
+    return v if isinstance(v, (int, float, str, bool, type(None), list)) else str(v)
+
+
+def metrics_logger(**kwargs) -> MetricsLogger:
+    return MetricsLogger(**kwargs)

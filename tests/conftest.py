@@ -1,0 +1,35 @@
+"""Test configuration: run everything on CPU with 8 virtual devices.
+
+Multi-device sharding logic is testable without TPU hardware via XLA's host
+platform device-count override — set before jax is first imported.
+"""
+
+import os
+
+# Force, don't setdefault: the sandbox exports JAX_PLATFORMS=axon (the real
+# TPU) and a sitecustomize re-asserts it, which would silently run the whole
+# suite on the TPU tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: makes repeated test runs much faster on the
+# slow sandbox CPU (compile once, reuse across pytest invocations).
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

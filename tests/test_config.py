@@ -1,0 +1,184 @@
+"""Tests for the training/model config system (args_utils.py parity)."""
+
+import os
+
+import pytest
+import yaml
+
+from relora_tpu.config.model import MODEL_ZOO, ModelConfig, load_model_config
+from relora_tpu.config.training import TrainingConfig, parse_token_count, parse_train_args
+
+
+def base_cfg(**kw):
+    d = dict(dataset_path="/tmp/ds", batch_size=4)
+    d.update(kw)
+    return TrainingConfig(**d)
+
+
+def test_requires_exactly_one_data_source():
+    with pytest.raises(ValueError, match="Exactly one"):
+        TrainingConfig(batch_size=4).finalize()
+    with pytest.raises(ValueError, match="Exactly one"):
+        TrainingConfig(
+            batch_size=4, dataset_path="/x", megatron_dataset_config="/y"
+        ).finalize()
+
+
+def test_batch_size_required():
+    with pytest.raises(ValueError, match="batch_size"):
+        TrainingConfig(dataset_path="/x").finalize()
+
+
+def test_total_batch_derivation():
+    cfg = base_cfg(gradient_accumulation=8).finalize()
+    assert cfg.total_batch_size == 32
+    cfg = base_cfg().finalize()
+    assert cfg.total_batch_size == 4 and cfg.gradient_accumulation == 1
+
+
+def test_grad_accum_for_world():
+    cfg = base_cfg(total_batch_size=1024, batch_size=8).finalize()
+    assert cfg.grad_accum_for(32) == 4
+    with pytest.raises(ValueError):
+        cfg.grad_accum_for(3)
+
+
+def test_max_train_tokens_overrides_steps():
+    cfg = base_cfg(total_batch_size=8, max_train_tokens="1M").finalize()
+    assert cfg.num_training_steps == 1_000_000 // 8
+    assert parse_token_count("2B") == 2_000_000_000
+    assert parse_token_count(100) == 100
+    assert parse_token_count(None) is None
+
+
+def test_fp16_rejected():
+    with pytest.raises(NotImplementedError):
+        base_cfg(dtype="fp16").finalize()
+
+
+def test_reset_modes_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        base_cfg(
+            reset_optimizer_on_relora=True, optimizer_magnitude_pruning=0.8
+        ).finalize()
+    cfg = base_cfg(
+        reset_optimizer_on_relora=False, optimizer_magnitude_pruning=0.8
+    ).finalize()
+    assert cfg.optimizer_reset_mode == "magnitude"
+    assert cfg.optimizer_reset_ratio == 0.8
+    cfg = base_cfg(reset_optimizer_on_relora=True).finalize()
+    assert cfg.optimizer_reset_mode == "zero"
+
+
+def test_relora_without_peft_dropped():
+    """Reference parity: args_utils clears relora before the (dead) promotion,
+    so --relora without --use_peft trains full-rank."""
+    cfg = base_cfg(relora=1000).finalize()
+    assert cfg.use_peft is False and cfg.relora is None
+    cfg = base_cfg(relora=1000, use_peft=True).finalize()
+    assert cfg.relora == 1000
+    cfg = base_cfg(use_peft=False).finalize()
+    assert cfg.relora is None and cfg.lora_r is None
+
+
+def test_skip_batches_parsing():
+    cfg = base_cfg(skip_batches="3,7,12").finalize()
+    assert cfg.skip_batches == {3, 7, 12}
+    cfg = base_cfg().finalize()
+    assert cfg.skip_batches == set()
+
+
+def test_yaml_roundtrip(tmp_path):
+    """A reference-format YAML (1B_v1.0.yaml style) loads correctly."""
+    raw = {
+        "dataset_path": "/tmp/ds",
+        "use_peft": True,
+        "lora_r": 128,
+        "relora": 1000,
+        "restart_warmup_steps": 100,
+        "reset_optimizer_on_relora": False,
+        "optimizer_magnitude_pruning": 0.8,
+        "batch_size": 8,
+        "total_batch_size": 1024,
+        "lr": "4e-4",  # yaml may leave scientific notation as str
+        "adam_beta2": 0.95,
+        "scheduler": "cosine_restarts",
+        "warmup_steps": 500,
+        "num_training_steps": 130000,
+        "dtype": "bfloat16",
+    }
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(raw))
+    cfg = TrainingConfig.from_yaml(str(p))
+    assert cfg.lr == 4e-4
+    assert cfg.optimizer_reset_mode == "magnitude"
+    assert cfg.total_batch_size == 1024
+
+    out = tmp_path / "resolved.yaml"
+    cfg.save(str(out))
+    again = yaml.safe_load(out.read_text())
+    assert again["relora"] == 1000
+
+
+def test_cli_parsing():
+    cfg = parse_train_args(
+        [
+            "--dataset_path", "/tmp/ds",
+            "--batch_size", "4",
+            "--relora", "100",
+            "--use_peft", "true",
+            "--lr", "1e-3",
+            "--scheduler", "cosine_restarts",
+            "--cycle_length", "100",
+            "--restart_warmup_steps", "10",
+        ]
+    )
+    assert cfg.relora == 100 and cfg.lr == 1e-3
+
+
+def test_cli_yaml_exclusive(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump({"dataset_path": "/tmp/ds", "batch_size": 2}))
+    with pytest.raises(RuntimeError, match="not both"):
+        parse_train_args(["--training_config", str(p), "--batch_size", "4"])
+    cfg = parse_train_args(["--training_config", str(p)])
+    assert cfg.batch_size == 2
+
+
+def test_model_zoo_sizes():
+    # spot-check against the reference JSON sweep
+    c = MODEL_ZOO["llama_35m"]
+    assert (c.hidden_size, c.intermediate_size, c.num_hidden_layers, c.num_attention_heads) == (384, 1024, 6, 8)
+    c = MODEL_ZOO["llama_1b"]
+    assert (c.hidden_size, c.intermediate_size, c.num_hidden_layers) == (2048, 5461, 24)
+    c = MODEL_ZOO["llama_7b"]
+    assert c.max_sequence_length == 2048 and c.hidden_size == 4096
+    assert load_model_config("llama_250m").vocab_size == 32100
+    # param count sanity: llama_250m should be ~250M incl embeddings
+    n = MODEL_ZOO["llama_250m"].num_params()
+    assert 200e6 < n < 300e6
+
+
+def test_model_config_hf_json(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(
+        '{"hidden_size": 384, "intermediate_size": 1024, "num_hidden_layers": 6,'
+        '"num_attention_heads": 8, "vocab_size": 32100, "max_sequence_length": 1024,'
+        '"rms_norm_eps": 1e-6, "model_type": "llama"}'
+    )
+    c = ModelConfig.from_hf_json(str(p))
+    assert c.family == "llama" and c.head_dim == 48
+
+
+def test_package_import_does_not_initialize_jax():
+    """Importing config/logging must not touch the XLA backend (it would break
+    a later jax.distributed.initialize() on multi-host)."""
+    import subprocess, sys
+
+    code = (
+        "import relora_tpu.config.training, relora_tpu.utils.logging, sys;"
+        "assert 'jax' not in sys.modules or not __import__('jax')._src.xla_bridge._backends,"
+        "'XLA backend initialized at import time'"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr

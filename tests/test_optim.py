@@ -1,0 +1,184 @@
+"""Tests for optimizer construction, resets, and pruning semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from relora_tpu.core.optim import (
+    build_optimizer,
+    clip_by_global_norm,
+    global_norm,
+    reset_optimizer_state,
+    zeroed_fraction,
+)
+from relora_tpu.core.schedules import linear_with_warmup
+
+
+def make_trainable_tree(rng=0):
+    k = jax.random.PRNGKey(rng)
+    ks = jax.random.split(k, 4)
+    return {
+        "layer": {
+            "q_proj": {
+                "lora_a": jax.random.normal(ks[0], (16, 4)),
+                "lora_b": jax.random.normal(ks[1], (4, 24)),
+            },
+            "norm": {"scale": jnp.ones((16,))},
+        },
+        "embed": {"embedding": jax.random.normal(ks[2], (32, 16))},
+    }
+
+
+def run_steps(tx, params, n=3):
+    state = tx.init(params)
+    for i in range(n):
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(jax.random.PRNGKey(i), p.shape), params
+        )
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    return params, state
+
+
+def find_adam_state(state):
+    if isinstance(state, optax.ScaleByAdamState):
+        return state
+    if isinstance(state, tuple):
+        for s in state:
+            found = find_adam_state(s)
+            if found is not None:
+                return found
+    return None
+
+
+def test_optimizer_updates_and_state_layout():
+    params = make_trainable_tree()
+    tx = build_optimizer(
+        schedule=linear_with_warmup(1e-3, 10, 100), weight_decay=0.01
+    )
+    new_params, state = run_steps(tx, params)
+    adam = find_adam_state(state)
+    assert adam is not None
+    # state mirrors the param tree: moments exist for every trainable leaf
+    assert jax.tree_util.tree_structure(adam.mu) == jax.tree_util.tree_structure(params)
+    # params actually moved
+    assert float(jnp.abs(new_params["embed"]["embedding"] - params["embed"]["embedding"]).max()) > 0
+
+
+@pytest.mark.parametrize("mode", ["zero", "random", "magnitude"])
+def test_reset_prunes_only_lora_moments(mode):
+    params = make_trainable_tree()
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    _, state = run_steps(tx, params)
+    before = find_adam_state(state)
+
+    ratio = {"zero": 1.0, "random": 0.9, "magnitude": 0.8}[mode]
+    new_state = reset_optimizer_state(
+        state, mode=mode, ratio=ratio, rng=jax.random.PRNGKey(0)
+    )
+    after = find_adam_state(new_state)
+
+    # non-LoRA moments untouched
+    np.testing.assert_array_equal(
+        np.asarray(after.mu["embed"]["embedding"]), np.asarray(before.mu["embed"]["embedding"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(after.nu["layer"]["norm"]["scale"]), np.asarray(before.nu["layer"]["norm"]["scale"])
+    )
+
+    # LoRA moments pruned
+    mu_a = np.asarray(after.mu["layer"]["q_proj"]["lora_a"])
+    z = (mu_a == 0).mean()
+    if mode == "zero":
+        assert z == 1.0
+    elif mode == "random":
+        assert 0.75 <= z <= 1.0  # ~90% zeroed
+    else:  # magnitude: quantile(0.8) keeps ~20% largest
+        assert 0.7 <= z <= 0.9
+
+    # Adam step count preserved (reference never resets it)
+    assert int(after.count) == int(before.count)
+
+
+def test_magnitude_pruning_keeps_largest():
+    t = jnp.asarray([[0.1, -5.0, 0.2, 4.0, -0.05, 3.0, 0.01, -2.0, 0.3, 1.0]])
+    state = optax.ScaleByAdamState(
+        count=jnp.asarray(1),
+        mu={"m": {"lora_a": t}},
+        nu={"m": {"lora_a": jnp.abs(t)}},
+    )
+    new = reset_optimizer_state((state,), mode="magnitude", ratio=0.7)
+    pruned = np.asarray(new[0].mu["m"]["lora_a"])[0]
+    # 70th percentile of |t| ~ 2.3 → keeps 5.0, 4.0, 3.0 (strictly greater)
+    kept = set(np.nonzero(pruned)[0].tolist())
+    assert kept == {1, 3, 5}
+
+
+def test_zeroed_fraction():
+    params = make_trainable_tree()
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    _, state = run_steps(tx, params)
+    assert float(zeroed_fraction(state)) < 0.1
+    state2 = reset_optimizer_state(state, mode="zero", ratio=1.0)
+    frac = float(zeroed_fraction(state2))
+    # lora moments are a large share of this tiny tree
+    n_lora = 16 * 4 + 4 * 24
+    n_total = n_lora + 16 + 32 * 16
+    assert frac == pytest.approx(n_lora / n_total, abs=0.05)
+
+
+def test_reset_is_jittable_structure_preserving():
+    params = make_trainable_tree()
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    _, state = run_steps(tx, params)
+    jitted = jax.jit(
+        lambda s, k: reset_optimizer_state(s, mode="random", ratio=0.9, rng=k)
+    )
+    out = jitted(state, jax.random.PRNGKey(1))
+    assert jax.tree_util.tree_structure(out) == jax.tree_util.tree_structure(state)
+
+
+def test_clip_by_global_norm():
+    tree = {"a": jnp.ones((10,)) * 3.0, "b": jnp.ones((5,)) * 4.0}
+    norm = float(global_norm(tree))
+    assert norm == pytest.approx(np.sqrt(10 * 9 + 5 * 16))
+    clipped, pre = clip_by_global_norm(tree, 1.0)
+    assert float(pre) == pytest.approx(norm)
+    assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    # no-op when under the limit
+    small = {"a": jnp.asarray([0.1])}
+    out, _ = clip_by_global_norm(small, 1.0)
+    np.testing.assert_allclose(np.asarray(out["a"]), [0.1], rtol=1e-6)
+
+
+def test_reset_recurses_into_wrapper_states():
+    """Regression: MultiSteps/multi_transform wrappers must not hide the Adam
+    state from the ReLoRA reset."""
+    params = make_trainable_tree()
+    inner = build_optimizer(schedule=lambda s: 1e-3)
+    tx = optax.MultiSteps(inner, every_k_schedule=2)
+    state = tx.init(params)
+    for i in range(4):
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(jax.random.PRNGKey(i), p.shape), params
+        )
+        _, state = tx.update(grads, state, params)
+    new_state = reset_optimizer_state(state, mode="zero", ratio=1.0)
+    adam = find_adam_state(jax.tree_util.tree_leaves(new_state, is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))[0] if False else new_state.inner_opt_state)
+    assert adam is not None
+    assert float(jnp.abs(adam.mu["layer"]["q_proj"]["lora_a"]).max()) == 0.0
+    assert float(jnp.abs(adam.mu["embed"]["embedding"]).max()) > 0.0
+
+
+def test_path_hash_deterministic():
+    from relora_tpu.core.optim import _path_hash
+
+    assert _path_hash(("layer", "lora_a")) == 2415058558 % (2**32) or isinstance(
+        _path_hash(("layer", "lora_a")), int
+    )
+    # stable across calls and independent of PYTHONHASHSEED (crc32-based)
+    import zlib
+
+    assert _path_hash(("a", "b")) == zlib.crc32(b"a/b")

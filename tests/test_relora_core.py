@@ -1,0 +1,160 @@
+"""Tests for LoRA leaf classification and the pure merge-and-reinit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.core.relora import (
+    LoraSpec,
+    frozen_param_mask,
+    kaiming_uniform,
+    lora_param_mask,
+    merge_and_reinit,
+    merged_params,
+    split_param_counts,
+    trainable_param_mask,
+)
+
+
+def make_params(rng=0, in_dim=16, out_dim=24, r=4, trainable_scaling=False):
+    k = jax.random.PRNGKey(rng)
+    ks = jax.random.split(k, 6)
+    mod = {
+        "kernel": jax.random.normal(ks[0], (in_dim, out_dim)) * 0.1,
+        "lora_a": jax.random.normal(ks[1], (in_dim, r)) * 0.1,
+        "lora_b": jax.random.normal(ks[2], (r, out_dim)) * 0.1,
+    }
+    if trainable_scaling:
+        mod["lora_s"] = jnp.asarray([0.5])
+    return {
+        "embed": {"embedding": jax.random.normal(ks[3], (32, in_dim))},
+        "layer": {
+            "q_proj": mod,
+            "norm": {"scale": jnp.ones((in_dim,))},
+            "plain": {"kernel": jax.random.normal(ks[4], (in_dim, in_dim)), "bias": jnp.zeros(in_dim)},
+        },
+    }
+
+
+def test_masks():
+    params = make_params()
+    lora = lora_param_mask(params)
+    assert lora["layer"]["q_proj"]["lora_a"] is True
+    assert lora["layer"]["q_proj"]["lora_b"] is True
+    assert lora["layer"]["q_proj"]["kernel"] is False
+    assert lora["embed"]["embedding"] is False
+
+    frozen = frozen_param_mask(params)
+    assert frozen["layer"]["q_proj"]["kernel"] is True
+    assert frozen["layer"]["plain"]["kernel"] is False
+    assert frozen["layer"]["norm"]["scale"] is False
+
+    train = trainable_param_mask(params)
+    assert train["layer"]["q_proj"]["kernel"] is False
+    assert train["layer"]["q_proj"]["lora_a"] is True
+    assert train["embed"]["embedding"] is True
+    assert train["layer"]["plain"]["kernel"] is True
+
+    only = trainable_param_mask(params, lora_only=True)
+    assert only["embed"]["embedding"] is False
+    assert only["layer"]["q_proj"]["lora_a"] is True
+
+
+def test_param_counts():
+    params = make_params(in_dim=8, out_dim=8, r=2)
+    counts = split_param_counts(params)
+    lora_n = 8 * 2 + 2 * 8
+    assert counts["lora_params"] == lora_n
+    assert counts["equivalent_params"] == counts["total_params"] - lora_n
+    assert counts["trainable_params"] == counts["total_params"] - 8 * 8  # minus frozen kernel
+
+
+def test_merge_math_and_reinit():
+    spec = LoraSpec(r=4, alpha=32)
+    params = make_params()
+    q = params["layer"]["q_proj"]
+    expected = q["kernel"] + (q["lora_a"] @ q["lora_b"]) * spec.scale
+
+    out = merge_and_reinit(params, jax.random.PRNGKey(1), spec)
+    q2 = out["layer"]["q_proj"]
+    np.testing.assert_allclose(np.asarray(q2["kernel"]), np.asarray(expected), rtol=1e-5)
+    # B zeroed, A re-drawn within the kaiming bound
+    assert float(jnp.abs(q2["lora_b"]).max()) == 0.0
+    bound = 1.0 / np.sqrt(q["lora_a"].shape[0])
+    assert float(jnp.abs(q2["lora_a"]).max()) <= bound
+    assert float(jnp.abs(q2["lora_a"]).max()) > 0.0
+    # untouched leaves identical
+    np.testing.assert_array_equal(np.asarray(out["embed"]["embedding"]), np.asarray(params["embed"]["embedding"]))
+    # structure preserved
+    assert jax.tree_util.tree_structure(out) == jax.tree_util.tree_structure(params)
+
+
+def test_merge_trainable_scaling_uses_tanh_and_resets():
+    spec = LoraSpec(r=4, alpha=32, trainable_scaling=True)
+    params = make_params(trainable_scaling=True)
+    q = params["layer"]["q_proj"]
+    expected = q["kernel"] + (q["lora_a"] @ q["lora_b"]) * jnp.tanh(q["lora_s"])
+    out = merge_and_reinit(params, jax.random.PRNGKey(1), spec)
+    np.testing.assert_allclose(
+        np.asarray(out["layer"]["q_proj"]["kernel"]), np.asarray(expected), rtol=1e-5
+    )
+    assert float(out["layer"]["q_proj"]["lora_s"][0]) == 0.0
+
+
+def test_merge_is_jittable_and_donation_safe():
+    spec = LoraSpec(r=4, alpha=32)
+    params = make_params()
+    fn = jax.jit(lambda p, k: merge_and_reinit(p, k, spec))
+    out = fn(params, jax.random.PRNGKey(2))
+    ref = merge_and_reinit(params, jax.random.PRNGKey(2), spec)
+    np.testing.assert_allclose(
+        np.asarray(out["layer"]["q_proj"]["kernel"]),
+        np.asarray(ref["layer"]["q_proj"]["kernel"]),
+        rtol=1e-6,
+    )
+
+
+def test_merged_params_drops_lora_leaves():
+    spec = LoraSpec(r=4, alpha=32)
+    params = make_params()
+    merged = merged_params(params, spec)
+    assert "lora_a" not in merged["layer"]["q_proj"]
+    q = params["layer"]["q_proj"]
+    np.testing.assert_allclose(
+        np.asarray(merged["layer"]["q_proj"]["kernel"]),
+        np.asarray(q["kernel"] + (q["lora_a"] @ q["lora_b"]) * spec.scale),
+        rtol=1e-5,
+    )
+
+
+def test_kaiming_uniform_bound_matches_torch_semantics():
+    # torch kaiming_uniform_(a=sqrt(5)) on (r, in): U(-1/sqrt(in), 1/sqrt(in))
+    key = jax.random.PRNGKey(0)
+    sample = kaiming_uniform(key, (64, 8))
+    bound = 1 / np.sqrt(64)
+    assert float(sample.max()) <= bound
+    assert float(sample.min()) >= -bound
+    # roughly uniform: std ~ bound/sqrt(3)
+    assert float(sample.std()) == pytest.approx(bound / np.sqrt(3), rel=0.15)
+
+
+def test_repeated_merges_accumulate_high_rank():
+    """The ReLoRA thesis: k merges of rank-r updates give rank up to k*r."""
+    spec = LoraSpec(r=2, alpha=2)  # scale 1
+    rng = jax.random.PRNGKey(3)
+    in_dim = out_dim = 16
+    params = {
+        "m": {
+            "kernel": jnp.zeros((in_dim, out_dim)),
+            "lora_a": jax.random.normal(jax.random.PRNGKey(10), (in_dim, 2)),
+            "lora_b": jax.random.normal(jax.random.PRNGKey(11), (2, out_dim)),
+        }
+    }
+    for i in range(4):
+        params = merge_and_reinit(params, jax.random.fold_in(rng, i), spec)
+        # simulate training: give B some random value so next merge adds new directions
+        params["m"]["lora_b"] = jax.random.normal(jax.random.PRNGKey(20 + i), (2, out_dim))
+    # after 4 merges with re-randomized factors, kernel rank should exceed r
+    rank = np.linalg.matrix_rank(np.asarray(params["m"]["kernel"]), tol=1e-5)
+    assert rank > 2

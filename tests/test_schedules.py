@@ -1,0 +1,163 @@
+"""Golden-value tests for LR schedules against an independent math oracle.
+
+The reference schedules are pure lambdas (training_utils.py:173-236); the
+oracles below re-derive them in plain Python/math so the jnp implementations
+are differentially tested step by step.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.core.schedules import (
+    cosine_with_restarts,
+    cyclical_cosine_with_min_lr,
+    linear_with_warmup,
+    make_schedule,
+)
+
+
+def oracle_cyclical_cosine(step, *, warmup, cycle_length, min_lr_ratio):
+    cycle_step = step % cycle_length
+    if cycle_step < warmup:
+        if step != cycle_step and cycle_step < 2:
+            return 1e-7
+        return cycle_step / max(1, warmup)
+    progress = (cycle_step - warmup) / max(1, cycle_length - warmup)
+    return min_lr_ratio + (1 - min_lr_ratio) * 0.5 * (1 + math.cos(math.pi * progress))
+
+
+def oracle_cosine_restarts(
+    step, *, total, first_warmup, restart_warmup, restart_every, min_lr_ratio, adjust_step=0
+):
+    if step < first_warmup:
+        return step / max(1, first_warmup)
+    s = step + adjust_step
+    restart_step = s % restart_every
+    restart_number = s // restart_every
+    if restart_step < restart_warmup and step >= restart_every:
+        end_progress = (restart_number * restart_every + restart_warmup - first_warmup) / max(
+            1, total - first_warmup
+        )
+        decay = 0.5 * (1 + math.cos(math.pi * end_progress))
+        target = min_lr_ratio + (1 - min_lr_ratio) * decay
+        return restart_step / max(1, restart_warmup) * target
+    progress = (s - first_warmup) / max(1, total - first_warmup)
+    decay = 0.5 * (1 + math.cos(math.pi * progress))
+    return min_lr_ratio + (1 - min_lr_ratio) * decay
+
+
+def test_linear_schedule():
+    sched = linear_with_warmup(1e-3, warmup_steps=100, num_training_steps=1000)
+    assert float(sched(0)) == 0.0
+    assert float(sched(50)) == pytest.approx(0.5e-3)
+    assert float(sched(100)) == pytest.approx(1e-3)
+    assert float(sched(550)) == pytest.approx(0.5e-3)
+    assert float(sched(1000)) == pytest.approx(0.0)
+
+
+def test_cyclical_cosine_matches_oracle():
+    kw = dict(warmup=50, cycle_length=500, min_lr_ratio=0.1)
+    sched = cyclical_cosine_with_min_lr(
+        peak_lr=1.0, warmup_steps=50, num_training_steps=2000, cycle_length=500, min_lr_ratio=0.1
+    )
+    steps = list(range(0, 2000, 7)) + [0, 1, 499, 500, 501, 502, 999, 1000, 1001]
+    got = np.asarray(sched(jnp.asarray(steps)))  # schedules are elementwise
+    want = np.array([oracle_cyclical_cosine(s, **kw) for s in steps])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_cyclical_cosine_later_cycle_quirk():
+    """First two steps of cycles after the first return 1e-7 (ref :179-183)."""
+    sched = cyclical_cosine_with_min_lr(1.0, 50, 2000, 500, 0.1)
+    assert float(sched(500)) == pytest.approx(1e-7)
+    assert float(sched(501)) == pytest.approx(1e-7)
+    assert float(sched(502)) == pytest.approx(2 / 50)
+    # First cycle unaffected
+    assert float(sched(0)) == 0.0
+    assert float(sched(1)) == pytest.approx(1 / 50)
+
+
+@pytest.mark.parametrize("adjust_step", [0, 150])
+def test_cosine_restarts_matches_oracle(adjust_step):
+    kw = dict(
+        total=10_000,
+        first_warmup=200,
+        restart_warmup=50,
+        restart_every=1000,
+        min_lr_ratio=0.1,
+        adjust_step=adjust_step,
+    )
+    sched = cosine_with_restarts(
+        peak_lr=1.0,
+        first_warmup_steps=200,
+        restart_warmup_steps=50,
+        restart_every=1000,
+        num_training_steps=10_000,
+        min_lr_ratio=0.1,
+        adjust_step=adjust_step,
+    )
+    steps = sorted(
+        set(
+            list(range(0, 10_000, 13))
+            + [0, 1, 199, 200, 999, 1000, 1001, 1049, 1050, 1051, 4999, 5000, 5049, 9999]
+        )
+    )
+    got = np.asarray(sched(jnp.asarray(steps)))
+    want = np.array([oracle_cosine_restarts(s, **kw) for s in steps])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_cosine_restarts_rewarmup_shape():
+    """After each restart, LR ramps linearly to the decayed envelope."""
+    sched = cosine_with_restarts(1.0, 100, 50, 1000, 10_000, 0.1)
+    # step 1000: restart boundary, LR drops to 0
+    assert float(sched(1000)) == pytest.approx(0.0)
+    # mid-rewarmup: half the envelope
+    env = float(sched(1050))
+    assert float(sched(1025)) == pytest.approx(env / 2 * (25 / 25) / 1, rel=0.3)
+    # monotone increase during rewarmup
+    vals = np.asarray(sched(jnp.arange(1000, 1051)))
+    assert (np.diff(vals) >= 0).all()
+    # after rewarmup, rejoins global cosine (decreasing)
+    vals = np.asarray(sched(jnp.arange(1050, 1200, 10)))
+    assert (np.diff(vals) <= 0).all()
+
+
+def test_cosine_restarts_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        cosine_with_restarts(1.0, 100, 50, 999, 10_000, 0.1)
+    with pytest.raises(ValueError, match="before the first warmup"):
+        cosine_with_restarts(1.0, 900, 50, 800, 8000, 0.1)
+    with pytest.raises(ValueError):
+        make_schedule("cosine", lr=1.0, num_training_steps=1000, warmup_steps=10,
+                      cycle_length=300)  # not divisible
+    with pytest.raises(ValueError, match="adjust_step"):
+        make_schedule("linear", lr=1.0, num_training_steps=1000, warmup_steps=10,
+                      adjust_step=5)
+
+
+def test_make_schedule_dispatch():
+    s = make_schedule(
+        "cosine_restarts",
+        lr=4e-4,
+        num_training_steps=130_000,
+        warmup_steps=500,
+        min_lr_ratio=0.1,
+        cycle_length=1000,
+        restart_warmup_steps=100,
+    )
+    # the 1B production recipe's schedule (training_configs/1B_v1.0.yaml)
+    assert float(s(0)) == 0.0
+    assert float(s(500)) == pytest.approx(4e-4, rel=1e-5)
+    assert float(s(130_000 - 1)) == pytest.approx(4e-5, rel=0.01)  # min_lr_ratio floor
+
+
+def test_schedule_is_jittable():
+    import jax
+
+    sched = cosine_with_restarts(1.0, 100, 50, 1000, 10_000, 0.1)
+    jitted = jax.jit(sched)
+    assert float(jitted(jnp.asarray(1025))) == pytest.approx(float(sched(1025)))
