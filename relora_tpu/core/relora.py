@@ -51,11 +51,12 @@ class LoraSpec:
 def kaiming_uniform(key: jax.Array, shape: Tuple[int, ...], dtype=jnp.float32) -> jax.Array:
     """torch's kaiming_uniform_(a=sqrt(5)) on a (out, in) weight = U(±1/sqrt(fan_in)).
 
-    Our lora_a is stored (in, r) (flax kernel convention), so fan_in is
-    shape[0].  Matches nn.init.kaiming_uniform_(lora_A.weight, a=math.sqrt(5))
-    at relora.py:251, 303.
+    Our lora_a is stored (..., in, r) (flax kernel convention, with optional
+    leading scan-layer axes), so fan_in is shape[-2].  Matches
+    nn.init.kaiming_uniform_(lora_A.weight, a=math.sqrt(5)) at
+    relora.py:251, 303.
     """
-    bound = 1.0 / math.sqrt(shape[0])
+    bound = 1.0 / math.sqrt(shape[-2])
     return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
 
 
@@ -134,8 +135,11 @@ def split_param_counts(params: PyTree) -> dict:
 
 def _effective_scale(module: dict, spec: LoraSpec):
     if spec.trainable_scaling and LORA_S in module:
-        # parity: trainable scaling passes through tanh (relora.py:263-267)
-        return jnp.tanh(module[LORA_S].astype(jnp.float32))
+        # parity: trainable scaling passes through tanh (relora.py:263-267).
+        # lora_s is (..., 1); reshape so it broadcasts over a (..., in, out)
+        # delta whether or not there is a leading scan-layer axis.
+        s = jnp.tanh(module[LORA_S].astype(jnp.float32))
+        return s.reshape(s.shape[:-1] + (1, 1))
     return spec.scale
 
 
@@ -150,7 +154,9 @@ def lora_delta(module: dict, spec: LoraSpec) -> jax.Array:
     """
     a = module[LORA_A].astype(jnp.float32)
     b = module[LORA_B].astype(jnp.float32)
-    delta = jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+    # einsum with ellipsis: supports both plain (in, r) @ (r, out) and
+    # scan-stacked (layers, in, r) @ (layers, r, out) factors.
+    delta = jnp.einsum("...ir,...ro->...io", a, b, precision=jax.lax.Precision.HIGHEST)
     return delta * _effective_scale(module, spec)
 
 

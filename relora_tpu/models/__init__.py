@@ -1,0 +1,2 @@
+from relora_tpu.models.lora import LoRALinear
+from relora_tpu.models.llama import LlamaForCausalLM

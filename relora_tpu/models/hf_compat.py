@@ -1,0 +1,180 @@
+"""HF checkpoint interop: torch/HF state dicts ↔ our Flax param trees.
+
+This serves three reference capabilities at once:
+
+- ``--warmed_up_model`` warm starts (full-rank weights into a LoRA-wrapped
+  model, torchrun_main.py:505-527),
+- ``--model_name_or_path EleutherAI/pythia-1b --model_revision step1000``
+  loads (the 1B production recipe, training_configs/1B_v1.0.yaml),
+- exporting trained models for HF-ecosystem evaluation (run_glue.py).
+
+Transfers are by-name (no torch execution needed beyond reading tensors) and
+work with either the scanned (stacked) or unrolled layer layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.models.params_util import stack_layers, unstack_layers
+
+PyTree = Any
+
+# my (unrolled) path -> HF llama state_dict key; kernels transpose (in,out)<->(out,in)
+_LLAMA_LAYER_MAP = {
+    "self_attn.q_proj.kernel": "self_attn.q_proj.weight",
+    "self_attn.k_proj.kernel": "self_attn.k_proj.weight",
+    "self_attn.v_proj.kernel": "self_attn.v_proj.weight",
+    "self_attn.o_proj.kernel": "self_attn.o_proj.weight",
+    "mlp.gate_proj.kernel": "mlp.gate_proj.weight",
+    "mlp.up_proj.kernel": "mlp.up_proj.weight",
+    "mlp.down_proj.kernel": "mlp.down_proj.weight",
+    "input_layernorm.scale": "input_layernorm.weight",
+    "post_attention_layernorm.scale": "post_attention_layernorm.weight",
+}
+
+_NEOX_LAYER_MAP = {
+    "attention.query_key_value.kernel": "attention.query_key_value.weight",
+    "attention.query_key_value.bias": "attention.query_key_value.bias",
+    "attention.dense.kernel": "attention.dense.weight",
+    "attention.dense.bias": "attention.dense.bias",
+    "mlp.dense_h_to_4h.kernel": "mlp.dense_h_to_4h.weight",
+    "mlp.dense_h_to_4h.bias": "mlp.dense_h_to_4h.bias",
+    "mlp.dense_4h_to_h.kernel": "mlp.dense_4h_to_h.weight",
+    "mlp.dense_4h_to_h.bias": "mlp.dense_4h_to_h.bias",
+    "input_layernorm.scale": "input_layernorm.weight",
+    "input_layernorm.bias": "input_layernorm.bias",
+    "post_attention_layernorm.scale": "post_attention_layernorm.weight",
+    "post_attention_layernorm.bias": "post_attention_layernorm.bias",
+}
+
+
+def _set_path(tree: Dict, dotted: str, value) -> None:
+    node = tree
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _get_path(tree: Mapping, dotted: str):
+    node = tree
+    for p in dotted.split("."):
+        node = node[p]
+    return node
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu")
+        if t.dtype.__str__() == "torch.bfloat16":
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)
+
+
+def hf_to_params(
+    state_dict: Mapping[str, Any],
+    config: ModelConfig,
+    scan_layers: bool = True,
+) -> PyTree:
+    """Build our param tree (base weights only, no LoRA leaves) from an HF
+    torch state_dict for Llama or GPT-NeoX/Pythia."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    if config.family == "llama":
+        params = _llama_from_hf(sd, config)
+    else:
+        params = _neox_from_hf(sd, config)
+    if scan_layers:
+        params = stack_layers(params, config.num_hidden_layers)
+    return params
+
+
+def _llama_from_hf(sd: Dict[str, np.ndarray], cfg: ModelConfig) -> PyTree:
+    p: Dict[str, Any] = {}
+    prefix = "model." if "model.embed_tokens.weight" in sd else ""
+    _set_path(p, "embed_tokens.embedding", sd[f"{prefix}embed_tokens.weight"])
+    _set_path(p, "norm.scale", sd[f"{prefix}norm.weight"])
+    _set_path(p, "lm_head.kernel", sd["lm_head.weight"].T)
+    for i in range(cfg.num_hidden_layers):
+        for ours, theirs in _LLAMA_LAYER_MAP.items():
+            w = sd[f"{prefix}layers.{i}.{theirs}"]
+            if ours.endswith(".kernel"):
+                w = w.T
+            _set_path(p, f"layers_{i}.{ours}", w)
+    return p
+
+
+def _neox_from_hf(sd: Dict[str, np.ndarray], cfg: ModelConfig) -> PyTree:
+    p: Dict[str, Any] = {}
+    prefix = "gpt_neox." if "gpt_neox.embed_in.weight" in sd else ""
+    _set_path(p, "embed_in.embedding", sd[f"{prefix}embed_in.weight"])
+    _set_path(p, "final_layer_norm.scale", sd[f"{prefix}final_layer_norm.weight"])
+    _set_path(p, "final_layer_norm.bias", sd[f"{prefix}final_layer_norm.bias"])
+    _set_path(p, "embed_out.kernel", sd["embed_out.weight"].T)
+    for i in range(cfg.num_hidden_layers):
+        for ours, theirs in _NEOX_LAYER_MAP.items():
+            w = sd[f"{prefix}layers.{i}.{theirs}"]
+            if ours.endswith(".kernel"):
+                w = w.T
+            # HF NeoX fuses qkv as interleaved (heads, 3, head_dim) on the out
+            # dim; our fused layout matches it exactly (see models/pythia.py),
+            # so no reshuffle is needed.
+            _set_path(p, f"layers_{i}.{ours}", w)
+    return p
+
+
+def params_to_hf(params: PyTree, config: ModelConfig) -> Dict[str, np.ndarray]:
+    """Export base weights (LoRA leaves must be merged/dropped first — see
+    core.relora.merged_params) to an HF-style numpy state dict."""
+    params = unstack_layers(dict(params))
+    sd: Dict[str, np.ndarray] = {}
+    if config.family == "llama":
+        sd["model.embed_tokens.weight"] = np.asarray(_get_path(params, "embed_tokens.embedding"))
+        sd["model.norm.weight"] = np.asarray(_get_path(params, "norm.scale"))
+        sd["lm_head.weight"] = np.asarray(_get_path(params, "lm_head.kernel")).T
+        for i in range(config.num_hidden_layers):
+            for ours, theirs in _LLAMA_LAYER_MAP.items():
+                w = np.asarray(_get_path(params, f"layers_{i}.{ours}"))
+                if ours.endswith(".kernel"):
+                    w = w.T
+                sd[f"model.layers.{i}.{theirs}"] = w
+    else:
+        sd["gpt_neox.embed_in.weight"] = np.asarray(_get_path(params, "embed_in.embedding"))
+        sd["gpt_neox.final_layer_norm.weight"] = np.asarray(_get_path(params, "final_layer_norm.scale"))
+        sd["gpt_neox.final_layer_norm.bias"] = np.asarray(_get_path(params, "final_layer_norm.bias"))
+        sd["embed_out.weight"] = np.asarray(_get_path(params, "embed_out.kernel")).T
+        for i in range(config.num_hidden_layers):
+            for ours, theirs in _NEOX_LAYER_MAP.items():
+                w = np.asarray(_get_path(params, f"layers_{i}.{ours}"))
+                if ours.endswith(".kernel"):
+                    w = w.T
+                sd[f"gpt_neox.layers.{i}.{theirs}"] = w
+    return sd
+
+
+def graft_base_weights(params: PyTree, base: PyTree) -> PyTree:
+    """Copy base (non-LoRA) weights from ``base`` into an initialized
+    (possibly LoRA-carrying) tree ``params`` — the warm-start operation
+    (torchrun_main.py:505-553: load full-rank weights, then wrap with LoRA).
+
+    Every leaf of ``base`` must exist in ``params``; LoRA leaves in ``params``
+    keep their fresh init.
+    """
+    import jax.numpy as jnp
+
+    def walk(p, b):
+        out = dict(p)
+        for k, v in b.items():
+            if isinstance(v, Mapping):
+                out[k] = walk(p[k], v)
+            else:
+                if p[k].shape != v.shape:
+                    raise ValueError(f"shape mismatch for {k}: {p[k].shape} vs {v.shape}")
+                out[k] = jnp.asarray(v, dtype=p[k].dtype)
+        return out
+
+    return walk(params, base)

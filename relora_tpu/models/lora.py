@@ -1,0 +1,103 @@
+"""LoRA-factored Dense layer: the TPU-native ReLoRaLinear.
+
+The reference swaps ``nn.Linear`` modules for ``ReLoRaLinear`` objects after
+model construction (relora.py:94-134) and tracks trainability with
+``requires_grad`` flags (relora.py:259-261).  Here LoRA is a property of the
+layer itself: when a ``LoraSpec`` is provided, the module owns extra pytree
+leaves ``lora_a`` / ``lora_b`` (and optionally ``lora_s``) next to its frozen
+``kernel``, and trainability is a *mask over the param tree*
+(relora_tpu.core.relora) — no module surgery, no flags.
+
+Forward (parity: relora.py:309-323)::
+
+    y = x @ W  (+ bias)  +  ((dropout(x) @ A) @ B) * scale
+
+Init: A ~ kaiming-uniform, B = 0 — so the wrapped model equals the base model
+at init (B=0 ⇒ the LoRA branch contributes nothing), which is the reference's
+own init-equivalence invariant (relora.py:120-124).  Deliberate deviation:
+the reference *additionally* zeroes A when keep_original_weights=True, which
+puts A/B at an exact saddle (both gradients identically zero) until the first
+merge re-draws A; we keep A at kaiming so learning starts immediately, while
+preserving the same init-equivalence guarantee.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from relora_tpu.core.relora import LoraSpec, kaiming_uniform
+
+
+class LoRALinear(nn.Module):
+    """Dense layer with optional LoRA factors as first-class pytree leaves.
+
+    ``kernel_axes`` are *logical* partitioning names resolved to mesh axes by
+    relora_tpu.parallel's rules; the rank axis is named "lora" (replicated by
+    default, shardable for very large models).
+    """
+
+    features: int
+    use_bias: bool = False
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: nn.initializers.Initializer = nn.initializers.normal(stddev=0.02)
+    kernel_axes: Tuple[Optional[str], Optional[str]] = (None, None)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
+            (in_features, self.features),
+            self.param_dtype,
+        )
+        y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), (self.kernel_axes[1],)),
+                (self.features,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+
+        if self.lora is not None:
+            spec = self.lora
+            lora_a = self.param(
+                "lora_a",
+                nn.with_logical_partitioning(
+                    lambda key, shape, dtype: kaiming_uniform(key, shape, dtype),
+                    (self.kernel_axes[0], "lora"),
+                ),
+                (in_features, spec.r),
+                self.param_dtype,
+            )
+            lora_b = self.param(
+                "lora_b",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("lora", self.kernel_axes[1])
+                ),
+                (spec.r, self.features),
+                self.param_dtype,
+            )
+            h = x
+            if spec.dropout > 0.0 and not deterministic:
+                h = nn.Dropout(rate=spec.dropout, deterministic=False)(h)
+            z = jnp.matmul(h.astype(self.dtype), lora_a.astype(self.dtype))
+            z = jnp.matmul(z, lora_b.astype(self.dtype))
+            if spec.trainable_scaling:
+                lora_s = self.param(
+                    "lora_s", nn.initializers.ones_init(), (1,), self.param_dtype
+                )
+                # parity: trainable scaling passes through tanh (relora.py:263-267)
+                scale = jnp.tanh(lora_s.astype(self.dtype))
+            else:
+                scale = spec.scale
+            y = y + z * scale
+        return y

@@ -1,0 +1,63 @@
+"""Param-tree layout utilities: scanned (stacked) ↔ unrolled decoder layers.
+
+``nn.scan`` stores all decoder-layer params stacked on a leading "layers"
+axis under one ``layers`` subtree; the unrolled module stores ``layers_0`` …
+``layers_{L-1}``.  These converters make the two layouts interchangeable for
+checkpoint interop, HF weight transfer, and differential tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+PyTree = Any
+
+
+def init_params(model: nn.Module, rng: jax.Array, *sample_args, **sample_kwargs) -> PyTree:
+    """Initialize and return a plain (unboxed) param tree.
+
+    Our modules annotate params with logical partitioning metadata
+    (``nn.with_logical_partitioning``); this strips the boxes for direct use.
+    Use ``logical_partition_specs`` to recover the sharding annotations.
+    """
+    variables = model.init(rng, *sample_args, **sample_kwargs)
+    return nn.meta.unbox(variables["params"])
+
+
+def logical_partition_specs(model: nn.Module, *sample_args, **sample_kwargs) -> PyTree:
+    """PartitionSpec tree (logical axis names) for the model's params, via
+    eval_shape — no memory is allocated."""
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), *sample_args, **sample_kwargs)
+    )
+    return nn.get_partition_spec(abstract)["params"]
+
+
+def unstack_layers(params: PyTree, layers_key: str = "layers") -> PyTree:
+    """(layers, ...) stacked tree -> layers_0..layers_{L-1} subtrees."""
+    if layers_key not in params:
+        return params
+    stacked = params[layers_key]
+    n_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != layers_key}
+    for i in range(n_layers):
+        out[f"{layers_key}_{i}"] = jax.tree_util.tree_map(lambda x: x[i], stacked)
+    return out
+
+
+def stack_layers(params: PyTree, n_layers: int, layers_key: str = "layers") -> PyTree:
+    """layers_0..layers_{L-1} subtrees -> one (layers, ...) stacked tree."""
+    if f"{layers_key}_0" not in params:
+        return params
+    out = {
+        k: v
+        for k, v in params.items()
+        if not (k.startswith(f"{layers_key}_") and k[len(layers_key) + 1 :].isdigit())
+    }
+    per_layer = [params[f"{layers_key}_{i}"] for i in range(n_layers)]
+    out[layers_key] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *per_layer)
+    return out

@@ -1,0 +1,71 @@
+"""Causal attention with selectable backends.
+
+The reference calls ``F.scaled_dot_product_attention(..., is_causal=True)``
+and deliberately ignores the padding mask (modeling_llama.py:221-224,
+modeling_pythia.py:262-270).  Here the same contract — causal, no padding
+mask — is served by three interchangeable implementations:
+
+- ``xla``     — ``jax.nn.dot_product_attention``: XLA fuses this into an
+  efficient (flash-like) kernel on TPU; the safe default everywhere.
+- ``pallas``  — the Pallas TPU flash-attention kernel
+  (jax.experimental.pallas.ops.tpu.flash_attention) for long sequences;
+  requires TPU and MXU-friendly head dims.
+- ``naive``   — explicit softmax(QKᵀ)V in f32, the differential-testing
+  oracle.
+
+All take/return ``(batch, seq, heads, head_dim)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
+    B, S, N, H = q.shape
+    logits = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, k.shape[1]), dtype=bool))
+        logits = jnp.where(mask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+    # pallas kernel wants (batch, heads, seq, head_dim)
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=scale)
+    return out.swapaxes(1, 2)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Causal SDPA over ``(B, S, N, H)`` tensors.
+
+    ``impl='auto'`` resolves to the XLA fused path (TPU-friendly on every
+    backend); 'pallas' opts into the handwritten flash kernel.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl == "auto":
+        impl = "xla"
+    if impl == "xla":
+        return jax.nn.dot_product_attention(q, k, v, scale=scale, is_causal=causal)
+    if impl == "pallas":
+        return _pallas_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "naive":
+        return _naive_attention(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"Unknown attention impl {impl!r}")

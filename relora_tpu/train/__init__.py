@@ -1,0 +1,1 @@
+from relora_tpu.train.losses import causal_lm_loss

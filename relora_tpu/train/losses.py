@@ -1,0 +1,36 @@
+"""Loss functions.
+
+Parity: the reference computes a shifted cross-entropy over all positions
+with labels = input_ids (torchrun_main.py:786, modeling_llama.py:694-708);
+pretokenized data is chunked with no padding, so no masking is needed, but we
+accept an optional mask for datasets that have one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_lm_loss(
+    logits: jax.Array,
+    input_ids: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shifted next-token CE in f32.
+
+    Returns ``(mean_loss, n_tokens)`` where n_tokens is the count the mean ran
+    over (needed by distributed eval aggregation, torchrun_main.py:159-183).
+    """
+    shift_logits = logits[:, :-1, :].astype(jnp.float32)
+    shift_labels = input_ids[:, 1:]
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        shift_mask = mask[:, 1:].astype(jnp.float32)
+        n = jnp.maximum(shift_mask.sum(), 1.0)
+        return -(token_ll * shift_mask).sum() / n, n
+    n = jnp.asarray(token_ll.size, jnp.float32)
+    return -token_ll.mean(), n

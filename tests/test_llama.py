@@ -1,0 +1,176 @@
+"""Llama model tests: shapes, init-equivalence, scan/unroll parity, merge
+losslessness at the model level, and a differential test against HF torch.
+
+These systematize the reference's notebook oracles (SURVEY.md §4):
+notebook 12 (wrapped == base at init) and notebook 11 (local model == HF).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec, lora_param_mask, merge_and_reinit, split_param_counts
+from relora_tpu.models.llama import LlamaForCausalLM
+from relora_tpu.models.params_util import stack_layers, unstack_layers
+from relora_tpu.train.losses import causal_lm_loss
+
+TINY = ModelConfig(
+    family="llama",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+)
+
+
+def init_model(lora=None, scan_layers=True, dtype=jnp.float32, **kw):
+    from relora_tpu.models.params_util import init_params
+
+    model = LlamaForCausalLM(TINY, lora=lora, dtype=dtype, scan_layers=scan_layers, **kw)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = init_params(model, jax.random.PRNGKey(0), ids)
+    return model, params
+
+
+def test_forward_shape_and_dtype():
+    model, params = init_model()
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, 256)
+    assert logits.dtype == jnp.float32
+    loss, n = causal_lm_loss(logits, ids)
+    assert loss.shape == () and float(n) == 2 * 15
+    assert 4.0 < float(loss) < 8.0  # ~ln(256) at random init
+
+
+def test_lora_init_equals_base_model():
+    """The reference's init-equivalence invariant (relora.py:120-124):
+    B=0 ⇒ the LoRA model's forward equals the base model's, given the same
+    base weights."""
+    spec = LoraSpec(r=8, alpha=32, dropout=0.0)
+    base_model, base_params = init_model(lora=None)
+    lora_model, lora_params = init_model(lora=spec)
+
+    # graft the base weights into the LoRA tree (keep fresh lora_a/lora_b)
+    from relora_tpu.models.hf_compat import graft_base_weights
+
+    grafted = graft_base_weights(lora_params, base_params)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 256)
+    out_base = base_model.apply({"params": base_params}, ids)
+    out_lora = lora_model.apply({"params": grafted}, ids)
+    np.testing.assert_allclose(np.asarray(out_base), np.asarray(out_lora), atol=1e-5)
+
+
+def test_lora_leaves_exist_only_in_target_modules():
+    spec = LoraSpec(r=8, alpha=32)
+    _, params = init_model(lora=spec)
+    mask = lora_param_mask(params)
+    leaves = jax.tree_util.tree_flatten_with_path(mask)[0]
+    lora_paths = ["/".join(str(getattr(k, "key", k)) for k in p) for p, v in leaves if v]
+    assert all(("self_attn" in p or "mlp" in p) for p in lora_paths)
+    assert not any("lm_head" in p or "embed" in p for p in lora_paths)
+    # q,k,v,o + gate,up,down = 7 modules × 2 leaves, stacked over layers
+    assert len(lora_paths) == 14
+    counts = split_param_counts(params)
+    assert counts["lora_params"] == 2 * (4 * (64 * 8 + 8 * 64) + 2 * (64 * 8 + 8 * 160) + (160 * 8 + 8 * 64))
+
+
+def test_scan_and_unrolled_agree():
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0)
+    scan_model, scan_params = init_model(lora=spec, scan_layers=True)
+    unrolled_model = LlamaForCausalLM(TINY, lora=spec, dtype=jnp.float32, scan_layers=False)
+    unrolled_params = unstack_layers(scan_params)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 256)
+    out_scan = scan_model.apply({"params": scan_params}, ids)
+    out_unrolled = unrolled_model.apply({"params": unrolled_params}, ids)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_unrolled), atol=1e-5)
+    # round trip layout conversion
+    restacked = stack_layers(unrolled_params, TINY.num_hidden_layers)
+    chex_equal = jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.array_equal(a, b)), scan_params, restacked)
+    )
+    assert chex_equal
+
+
+def test_model_level_merge_is_lossless():
+    """Merge-and-reinit must not change the function the model computes
+    (oracle (b) from SURVEY.md §4)."""
+    spec = LoraSpec(r=8, alpha=32, dropout=0.0)
+    model, params = init_model(lora=spec)
+    # give lora_b nonzero values so the merge actually moves weight
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, x: jax.random.normal(jax.random.PRNGKey(5), x.shape) * 0.02
+        if "lora_b" in str(p[-1])
+        else x,
+        params,
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 256)
+    out_before = model.apply({"params": params}, ids)
+    merged = merge_and_reinit(params, jax.random.PRNGKey(6), spec)
+    out_after = model.apply({"params": merged}, ids)
+    np.testing.assert_allclose(np.asarray(out_before), np.asarray(out_after), atol=2e-4)
+
+
+def test_remat_matches_no_remat():
+    model, params = init_model(remat=False)
+    remat_model = LlamaForCausalLM(TINY, dtype=jnp.float32, scan_layers=True, remat=True)
+    ids = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0, 256)
+
+    def loss(m, p):
+        return causal_lm_loss(m.apply({"params": p}, ids), ids)[0]
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(model, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(remat_model, p))(params)
+    assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_attention_impls_agree():
+    from relora_tpu.ops.attention import dot_product_attention
+
+    k = jax.random.PRNGKey(0)
+    q, kk, v = (jax.random.normal(jax.random.fold_in(k, i), (2, 32, 4, 16)) for i in range(3))
+    out_xla = dot_product_attention(q, kk, v, causal=True, impl="xla")
+    out_naive = dot_product_attention(q, kk, v, causal=True, impl="naive")
+    np.testing.assert_allclose(np.asarray(out_xla), np.asarray(out_naive), atol=2e-5)
+
+
+@pytest.mark.slow
+def test_against_hf_torch_llama():
+    """Differential oracle: our forward vs transformers' torch Llama with
+    identical weights (systematizes notebook 11)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    from relora_tpu.models.hf_compat import hf_to_params
+
+    hf_cfg = HFConfig(
+        vocab_size=TINY.vocab_size,
+        hidden_size=TINY.hidden_size,
+        intermediate_size=TINY.intermediate_size,
+        num_hidden_layers=TINY.num_hidden_layers,
+        num_attention_heads=TINY.num_attention_heads,
+        num_key_value_heads=TINY.num_attention_heads,
+        max_position_embeddings=TINY.max_sequence_length,
+        rms_norm_eps=TINY.rms_norm_eps,
+        rope_theta=TINY.rotary_emb_base,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf_model = HFLlama(hf_cfg).eval()
+    params = hf_to_params(hf_model.state_dict(), TINY, scan_layers=True)
+
+    ids_np = np.random.RandomState(0).randint(0, TINY.vocab_size, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids_np)).logits.numpy()
+
+    model = LlamaForCausalLM(TINY, dtype=jnp.float32, scan_layers=True)
+    ours = model.apply({"params": jax.tree_util.tree_map(jnp.asarray, params)}, jnp.asarray(ids_np))
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=2e-4, rtol=2e-3)
