@@ -1,0 +1,73 @@
+"""Offline pretokenization CLI (parity: reference pretokenize.py:38-88).
+
+Downloads/loads an HF dataset, tokenizes + chunks it into fixed-length
+blocks, and saves to disk together with an ``args.json`` provenance file
+that training validates against (torchrun_main.py:452-455).
+
+Example::
+
+    python pretokenize.py --dataset allenai/c4 --subset en \
+        --tokenizer t5-base --sequence_length 512 --num_proc 8 \
+        --save_dir data/c4_tok --take 100000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--subset", default=None)
+    p.add_argument("--split", default="train")
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--text_field", default="text")
+    p.add_argument("--sequence_length", type=int, default=512)
+    p.add_argument("--num_proc", type=int, default=8)
+    p.add_argument("--save_dir", required=True)
+    p.add_argument("--take", type=int, default=None, help="Only tokenize the first N documents")
+    args = p.parse_args(argv)
+
+    import datasets
+    from transformers import AutoTokenizer
+
+    from relora_tpu.data.hf_pipeline import tokenize_and_chunk
+
+    t0 = time.time()
+    if os.path.isdir(args.dataset):
+        ds = datasets.load_from_disk(args.dataset)
+        if isinstance(ds, datasets.DatasetDict):
+            ds = ds[args.split]
+    elif args.take is not None:
+        stream = datasets.load_dataset(
+            args.dataset, args.subset, split=args.split, streaming=True
+        )
+        ds = datasets.Dataset.from_list(list(stream.take(args.take)))
+    else:
+        ds = datasets.load_dataset(args.dataset, args.subset, split=args.split)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    out = tokenize_and_chunk(
+        ds,
+        tokenizer,
+        text_field=args.text_field,
+        sequence_length=args.sequence_length,
+        num_proc=args.num_proc,
+    )
+    os.makedirs(args.save_dir, exist_ok=True)
+    out.save_to_disk(args.save_dir)
+    with open(os.path.join(args.save_dir, "args.json"), "w") as f:
+        json.dump({**vars(args), "n_sequences": len(out)}, f, indent=2)
+    print(
+        f"Saved {len(out)} sequences x {args.sequence_length} tokens "
+        f"({len(out) * args.sequence_length:,} tokens) to {args.save_dir} "
+        f"in {time.time() - t0:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -171,6 +171,25 @@ def _mask_lookup(mask: PyTree, path: Tuple) -> bool:
     return bool(node)
 
 
+def set_schedule_count(opt_state: PyTree, count: int) -> PyTree:
+    """Set the step counter of every ScaleByScheduleState (used when resuming
+    without restoring optimizer state, so the LR schedule continues from the
+    checkpoint's position — parity: the scheduler replay at
+    torchrun_main.py:693-699)."""
+    import jax.numpy as jnp
+
+    def walk(state):
+        if isinstance(state, optax.ScaleByScheduleState):
+            return state._replace(count=jnp.asarray(count, jnp.int32))
+        if isinstance(state, tuple):
+            if hasattr(state, "_fields"):
+                return type(state)(*(walk(s) for s in state))
+            return tuple(walk(s) for s in state)
+        return state
+
+    return walk(opt_state)
+
+
 def zeroed_fraction(opt_state: PyTree) -> jax.Array:
     """Fraction of zeros across all Adam moments (parity logging:
     training_utils.py:363-364)."""

@@ -1,0 +1,35 @@
+"""Trainable/frozen param-tree partitioning.
+
+The train step differentiates only the trainable subtree (LoRA factors +
+embeddings/norms/lm_head); the frozen base kernels are closed over — so no
+gradient or optimizer state is ever materialized for them.  This is the
+reference's ``requires_grad`` split (torchrun_main.py:631-633) expressed as
+tree surgery, and it is what makes ReLoRA's HBM savings real on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+
+PyTree = Any
+
+
+def _is_none(x) -> bool:
+    return x is None
+
+
+def partition(params: PyTree, mask: PyTree) -> Tuple[PyTree, PyTree]:
+    """Split into (selected, rest); non-selected positions become None leaves
+    (None is a valid empty-subtree marker for jax transformations)."""
+    selected = jax.tree_util.tree_map(lambda p, m: p if m else None, params, mask)
+    rest = jax.tree_util.tree_map(lambda p, m: None if m else p, params, mask)
+    return selected, rest
+
+
+def combine(a: PyTree, b: PyTree) -> PyTree:
+    """Inverse of partition: positions that are None in ``a`` come from ``b``."""
+    return jax.tree_util.tree_map(
+        lambda x, y: y if x is None else x, a, b, is_leaf=_is_none
+    )

@@ -1,0 +1,5 @@
+from relora_tpu.data.hf_pipeline import (
+    tokenize_and_chunk,
+    TokenBatchIterator,
+    StreamingTokenIterator,
+)

@@ -1,0 +1,174 @@
+"""HF datasets pipeline: tokenize-and-chunk plus resumable batch iterators.
+
+Capability parity with peft_pretraining/dataloader.py:
+
+- ``tokenize_and_chunk``       — tokenize + append EOS, concatenate and cut
+  into fixed ``seq_length`` blocks, drop the remainder, drop attention masks
+  (:57-124).  Used offline by pretokenize.py and validated at train time via
+  the args.json provenance file.
+- ``TokenBatchIterator``       — batches a pretokenized dataset into
+  ``(grad_accum, microbatch, seq)`` device-ready numpy arrays with
+  deterministic skip for resume (SkipDataLoader semantics, :128-170) and
+  per-host sharding (each JAX process reads only its slice — replacing
+  datasets.distributed.split_dataset_by_node, torchrun_main.py:722-723).
+- ``StreamingTokenIterator``   — on-the-fly tokenize+pack for iterable/raw
+  datasets (PreprocessedIterableDataset semantics, :13-54).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def tokenize_and_chunk(
+    dataset,
+    tokenizer,
+    text_field: str = "text",
+    sequence_length: int = 512,
+    num_proc: int = 8,
+):
+    """Pretokenize a text dataset into fixed-length input_ids blocks.
+
+    Every document gets an EOS appended, documents are concatenated, the
+    stream is cut into ``sequence_length`` chunks and the tail remainder is
+    dropped (parity: dataloader.py:57-124 — including the "extra [EOS]"
+    between documents behavior).
+    """
+    eos = tokenizer.eos_token_id
+    if eos is None:
+        raise ValueError("tokenizer must define an EOS token")
+
+    def tokenize(examples):
+        out = tokenizer(examples[text_field], add_special_tokens=False)
+        return {"input_ids": [ids + [eos] for ids in out["input_ids"]]}
+
+    tokenized = dataset.map(
+        tokenize,
+        batched=True,
+        num_proc=num_proc,
+        remove_columns=list(dataset.column_names),
+        desc="tokenizing",
+    )
+
+    def group(examples):
+        concat = list(itertools.chain.from_iterable(examples["input_ids"]))
+        total = (len(concat) // sequence_length) * sequence_length
+        return {
+            "input_ids": [
+                concat[i : i + sequence_length] for i in range(0, total, sequence_length)
+            ]
+        }
+
+    return tokenized.map(
+        group, batched=True, num_proc=num_proc, desc="chunking"
+    )
+
+
+class TokenBatchIterator:
+    """Device-ready batches from a pretokenized dataset.
+
+    Yields int32 arrays of shape ``(grad_accum, microbatch, seq)`` (train) or
+    ``(microbatch, seq)`` (eval, grad_accum=None).  ``skip_updates`` fast-
+    forwards whole update steps for resume — index arithmetic, not data reads
+    (cheaper than the reference's batch-consuming SkipDataLoader,
+    dataloader.py:150-170).  ``process_index/process_count`` shard batches
+    across hosts contiguously at the batch level, mirroring
+    DistributedBatchSampler rank slicing (megatron_dataset/samplers.py:159-165).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        *,
+        microbatch: int,
+        grad_accum: Optional[int] = None,
+        skip_updates: int = 0,
+        process_index: int = 0,
+        process_count: int = 1,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.microbatch = microbatch
+        self.grad_accum = grad_accum
+        self.process_index = process_index
+        self.process_count = process_count
+        seqs_per_update = microbatch * (grad_accum or 1) * process_count
+        self._seqs_per_update = seqs_per_update
+        self._start = skip_updates * seqs_per_update
+        n = len(dataset)
+        self._n_updates_total = n // seqs_per_update if drop_last else -(-n // seqs_per_update)
+
+    def __len__(self) -> int:
+        return max(0, self._n_updates_total - self._start // self._seqs_per_update)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        mb, ga, pc, pi = (
+            self.microbatch,
+            self.grad_accum,
+            self.process_count,
+            self.process_index,
+        )
+        per_host = mb * (ga or 1)
+        for start in range(self._start, self._n_updates_total * self._seqs_per_update, self._seqs_per_update):
+            # contiguous per-host slice within the global update batch
+            lo = start + pi * per_host
+            rows = self.dataset[lo : lo + per_host]["input_ids"]
+            arr = np.asarray(rows, dtype=np.int32)
+            if ga is None:
+                yield arr
+            else:
+                yield arr.reshape(ga, mb, -1)
+
+
+class StreamingTokenIterator:
+    """On-the-fly tokenize + pack for raw/iterable text datasets
+    (parity: PreprocessedIterableDataset, dataloader.py:13-54).
+
+    Documents are tokenized with EOS appended and packed into a rolling token
+    buffer; full ``(grad_accum, microbatch, seq)`` batches are emitted as the
+    buffer fills.  Worker sharding is by document index (islice semantics).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        tokenizer,
+        *,
+        text_field: str = "text",
+        sequence_length: int,
+        microbatch: int,
+        grad_accum: int = 1,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        self.dataset = dataset
+        self.tokenizer = tokenizer
+        self.text_field = text_field
+        self.sequence_length = sequence_length
+        self.microbatch = microbatch
+        self.grad_accum = grad_accum
+        self.process_index = process_index
+        self.process_count = process_count
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        eos = self.tokenizer.eos_token_id
+        need = self.sequence_length * self.microbatch * self.grad_accum
+        buffer: list[int] = []
+        docs = itertools.islice(
+            iter(self.dataset), self.process_index, None, self.process_count
+        )
+        for doc in docs:
+            ids = self.tokenizer(doc[self.text_field], add_special_tokens=False)["input_ids"]
+            buffer.extend(ids)
+            buffer.append(eos)
+            while len(buffer) >= need:
+                chunk = np.asarray(buffer[:need], dtype=np.int32)
+                buffer = buffer[need:]
+                yield chunk.reshape(self.grad_accum, self.microbatch, self.sequence_length)

@@ -1,0 +1,7 @@
+from relora_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    LOGICAL_RULES,
+    param_shardings,
+    batch_sharding,
+)

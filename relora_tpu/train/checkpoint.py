@@ -1,0 +1,148 @@
+"""Checkpoint / resume: Orbax-backed sharded state + reference-schema JSON.
+
+Reference checkpoint dir ``model_{update_step}`` holds the HF model files,
+``optimizer.pt``, ``relora_config.json`` and ``training_state.json``
+(torchrun_main.py:192-225, 256-273).  Here each ``model_{step}`` dir holds:
+
+- ``state/``               — Orbax checkpoint of the full TrainState
+  (params + optimizer state + step counters), saved **sharded**: every host
+  writes its own shards (the reference funnels everything through rank 0 and
+  notes it as a limitation, torchrun_main.py:508).
+- ``training_state.json``  — the reference's counter schema, unchanged
+  (global_step, update_step, tokens_seen, tokens_seen_before,
+  n_lora_restarts, n_optimizer_resets, update_time, wandb_id).
+- ``relora_config.json``   — LoraSpec (parity: relora.py:149-152).
+
+Resume modes (parity: §3.5 of SURVEY.md):
+- ``autoresume``    — find latest ``model_*`` in save_dir
+  (training_utils.py:248-264).
+- ``resume_from``   — explicit dir: full state restore.
+- ``warmed_up_model`` — weights + counters only, fresh optimizer
+  (torchrun_main.py:505-527).
+Retention: ``delete_old_checkpoints`` keeps the newest N
+(training_utils.py:406-418).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Mapping, Optional, Tuple
+
+import jax
+
+from relora_tpu.core.relora import LoraSpec
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PyTree = Any
+
+STATE_SUBDIR = "state"
+TRAINING_STATE_FILE = "training_state.json"
+RELORA_CONFIG_FILE = "relora_config.json"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def checkpoint_dir(save_dir: str, update_step: int) -> str:
+    return os.path.join(save_dir, f"model_{update_step}")
+
+
+def save_checkpoint(
+    save_dir: str,
+    update_step: int,
+    state: PyTree,
+    training_state: dict,
+    lora_spec: Optional[LoraSpec] = None,
+) -> str:
+    """Write one checkpoint dir; returns its path.  Safe to call from every
+    process — Orbax coordinates the multi-host write; JSON goes from
+    process 0 only."""
+    path = checkpoint_dir(save_dir, update_step)
+    os.makedirs(path, exist_ok=True)
+    ckptr = _checkpointer()
+    state_path = os.path.abspath(os.path.join(path, STATE_SUBDIR))
+    if os.path.exists(state_path):
+        shutil.rmtree(state_path)
+    ckptr.save(state_path, state)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        with open(os.path.join(path, TRAINING_STATE_FILE), "w") as f:
+            json.dump(training_state, f, indent=2)
+        if lora_spec is not None:
+            with open(os.path.join(path, RELORA_CONFIG_FILE), "w") as f:
+                json.dump(dataclasses.asdict(lora_spec), f, indent=2)
+    logger.info(f"Saved checkpoint to {path}")
+    return path
+
+
+def restore_checkpoint(path: str, abstract_state: PyTree) -> PyTree:
+    """Restore a TrainState saved by ``save_checkpoint``.
+
+    ``abstract_state`` — e.g. ``jax.eval_shape(lambda: state)`` with sharding
+    annotations — tells Orbax the target shapes/shardings, so restore places
+    shards directly on the mesh."""
+    ckptr = _checkpointer()
+    return ckptr.restore(os.path.abspath(os.path.join(path, STATE_SUBDIR)), abstract_state)
+
+
+def restore_params_host(path: str) -> PyTree:
+    """Template-free restore of just the saved params subtree, as host numpy
+    arrays.  Used for warm starts, where the saved tree (e.g. full-rank, its
+    own optimizer) deliberately differs from the new run's state shape."""
+    import orbax.checkpoint as ocp
+
+    restored = ocp.PyTreeCheckpointer().restore(
+        os.path.abspath(os.path.join(path, STATE_SUBDIR))
+    )
+    if isinstance(restored, Mapping) and "params" in restored:
+        return restored["params"]
+    return restored
+
+
+def load_training_state(path: str) -> dict:
+    with open(os.path.join(path, TRAINING_STATE_FILE)) as f:
+        return json.load(f)
+
+
+def load_lora_spec(path: str) -> Optional[LoraSpec]:
+    p = os.path.join(path, RELORA_CONFIG_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return LoraSpec(**json.load(f))
+
+
+def get_last_checkpoint(save_dir: str) -> Tuple[Optional[dict], Optional[str]]:
+    """Find the newest ``model_{step}`` dir and its training_state.json
+    (parity: training_utils.get_last_training_state :248-264)."""
+    if not os.path.isdir(save_dir):
+        return None, None
+    dirs = [d for d in os.listdir(save_dir) if d.startswith("model_")]
+    if not dirs:
+        logger.warning(f"Save directory {save_dir} exists but has no checkpoints; starting fresh")
+        return None, None
+    dirs.sort(key=lambda d: int(d.split("_")[-1]))
+    path = os.path.join(save_dir, dirs[-1])
+    return load_training_state(path), path
+
+
+def delete_old_checkpoints(save_dir: str, keep: Optional[int]) -> None:
+    """Keep the newest N checkpoint dirs (parity: training_utils.py:406-418)."""
+    if keep is None or jax.process_index() != 0:
+        return
+    dirs = [d for d in os.listdir(save_dir) if d.startswith("model_")]
+    if len(dirs) <= keep:
+        return
+    dirs.sort(key=lambda d: int(d.split("_")[-1]))
+    for d in dirs[:-keep]:
+        full = os.path.join(save_dir, d)
+        logger.info(f"Deleting old checkpoint {full}")
+        shutil.rmtree(full)

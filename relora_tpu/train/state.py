@@ -1,0 +1,33 @@
+"""Training state: one pytree carrying everything a step mutates.
+
+Unlike the reference (mutable model + optimizer objects + loose Python
+counters, torchrun_main.py:749-753), all device state lives in one immutable
+struct so steps are pure, donation-friendly, and checkpointable as a unit.
+Host-side counters (tokens_seen, wall-clock) stay in the Trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array  # update_step (optimizer applications + NaN-skipped steps)
+    params: PyTree  # full tree: frozen kernels + trainable leaves
+    opt_state: PyTree  # optax state over the *trainable subtree* only
+    n_skipped: jax.Array  # NaN-gated skipped updates (torchrun_main.py:817-822)
+
+    @classmethod
+    def create(cls, params: PyTree, opt_state: PyTree) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            n_skipped=jnp.zeros((), jnp.int32),
+        )

@@ -1,0 +1,141 @@
+"""The jitted train/eval steps: scan gradient accumulation, NaN gating,
+clipping — one compiled program per recipe.
+
+Reference hot loop (torchrun_main.py:768-944): per-microbatch forward/backward
+with Python-side accumulation, clip_grad_norm over trainable params (:805-808),
+an all-reduced NaN gate that skips optimizer *and* scheduler on any NaN in the
+update (:810-822), counters incremented regardless.
+
+Here the whole update is one XLA program: ``lax.scan`` over the microbatch
+axis accumulates grads on-device (no host round trips, reference's
+grad-accum loop :796-800), the NaN gate is a ``jnp.where`` masked state
+select (schedule state rolls back too, exactly matching the reference's
+frozen scheduler on skipped steps), and under a mesh the batch/param
+shardings make XLA insert the DDP/FSDP collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from relora_tpu.core.optim import clip_by_global_norm
+from relora_tpu.core.partition import combine, partition
+from relora_tpu.train.losses import causal_lm_loss
+from relora_tpu.train.state import TrainState
+
+PyTree = Any
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    trainable_mask: PyTree,
+    *,
+    clip_grad_norm: float = 1.0,
+    schedule: Optional[Callable] = None,
+) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
+    """Build ``train_step(state, batch, rng) -> (state, metrics)``.
+
+    ``batch``: int32 token ids shaped ``(grad_accum, microbatch, seq)``.
+    The returned function is pure; jit it with donated state, e.g.::
+
+        step = jax.jit(make_train_step(...), donate_argnums=0)
+    """
+
+    def loss_fn(trainable: PyTree, frozen: PyTree, tokens: jax.Array, rng) -> jax.Array:
+        params = combine(trainable, frozen)
+        logits = model.apply(
+            {"params": params},
+            tokens,
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        loss, _ = causal_lm_loss(logits, tokens)
+        return loss
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def train_step(state: TrainState, batch: jax.Array, rng: jax.Array):
+        trainable, frozen = partition(state.params, trainable_mask)
+        ga = batch.shape[0]
+        rngs = jax.random.split(rng, ga)
+
+        def micro(acc, inp):
+            tokens, mrng = inp
+            loss, grads = grad_fn(trainable, frozen, tokens, mrng)
+            acc_grads, acc_loss, acc_nan = acc
+            acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
+            return (acc_grads, acc_loss + loss, acc_nan + jnp.isnan(loss)), None
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), trainable
+        )
+        (grads, loss_sum, nan_count), _ = jax.lax.scan(
+            micro, (zero_grads, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (batch, rngs)
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / ga, grads)
+        mean_loss = loss_sum / ga
+
+        if clip_grad_norm > 0:
+            grads, grad_norm = clip_by_global_norm(grads, clip_grad_norm)
+        else:
+            from relora_tpu.core.optim import global_norm
+
+            grad_norm = global_norm(grads)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+
+        # NaN gate (parity: torchrun_main.py:813-822): on any NaN in the
+        # accumulated update, keep params AND optimizer/schedule state
+        # unchanged (the reference skips optimizer.step() and
+        # scheduler.step()); update_step still advances.
+        skip = (nan_count > 0) | ~jnp.isfinite(grad_norm)
+
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(skip, o, n), new, old
+            )
+
+        final_trainable = select(new_trainable, trainable)
+        final_opt_state = select(new_opt_state, state.opt_state)
+
+        new_state = state.replace(
+            step=state.step + 1,
+            params=combine(final_trainable, frozen),
+            opt_state=final_opt_state,
+            n_skipped=state.n_skipped + skip.astype(jnp.int32),
+        )
+        metrics = {
+            "loss": mean_loss,
+            "grad_norm": grad_norm,
+            "skipped": skip.astype(jnp.float32),
+            "n_skipped": new_state.n_skipped,
+        }
+        if schedule is not None:
+            metrics["lr"] = schedule(state.step)
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable[[PyTree, jax.Array], dict]:
+    """``eval_step(params, tokens) -> {loss_sum_weighted, n_tokens}``.
+
+    Under jit with a sharded batch, the sums are global (XLA inserts the
+    psum) — the explicit ``dist.all_reduce`` of the reference's
+    evaluate_model (torchrun_main.py:159-183) is implicit here.  Caller
+    divides accumulated loss by accumulated tokens.
+    """
+
+    def eval_step(params: PyTree, tokens: jax.Array) -> dict:
+        logits = model.apply({"params": params}, tokens, deterministic=True)
+        loss, n = causal_lm_loss(logits, tokens)
+        return {"loss_sum": loss * n, "n_tokens": n}
+
+    return eval_step

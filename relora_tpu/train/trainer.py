@@ -1,0 +1,583 @@
+"""Training orchestration: the TPU-native torchrun_main.main().
+
+Owns what the reference's 700-line main() owns (torchrun_main.py:338-1018):
+mesh/process setup, model+optimizer construction, warm-start / resume /
+autoresume, the update loop with its two reset triggers, NaN accounting,
+evaluation, checkpointing, and metrics — but with all device work factored
+into the pure jitted functions of relora_tpu.train.step /
+core.relora / core.optim, so the loop itself is trivial host logic.
+
+Trigger semantics preserved exactly (SURVEY.md §3.1): resets fire at
+``(update_step - scheduler_start_step) % cycle == 1`` — the step *after* the
+scheduler boundary — and are gated by ``can_reset_*`` so a warm-started model
+completes its first partial cycle (torchrun_main.py:874-912); ``relora``
+(merge cadence) and ``cycle_length`` (optimizer/LR cadence) stay independent
+knobs.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relora_tpu.config.model import ModelConfig, load_model_config
+from relora_tpu.config.training import TrainingConfig
+from relora_tpu.core.optim import build_optimizer, reset_optimizer_state, zeroed_fraction
+from relora_tpu.core.partition import partition
+from relora_tpu.core.relora import (
+    LoraSpec,
+    merge_and_reinit,
+    split_param_counts,
+    trainable_param_mask,
+)
+from relora_tpu.core.schedules import make_schedule
+from relora_tpu.models.llama import LlamaForCausalLM
+from relora_tpu.models.params_util import init_params, logical_partition_specs
+from relora_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+)
+from relora_tpu.train import checkpoint as ckpt
+from relora_tpu.train.state import TrainState
+from relora_tpu.train.step import make_eval_step, make_train_step
+from relora_tpu.utils.logging import MetricsLogger, get_logger, set_process_index
+
+logger = get_logger(__name__)
+
+PyTree = Any
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+}
+
+
+def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingConfig):
+    compute_dtype = _DTYPES[cfg.dtype]
+    kwargs = dict(
+        config=model_cfg,
+        lora=lora,
+        dtype=compute_dtype,
+        scan_layers=True,
+        remat=cfg.remat,
+        attention_impl="pallas" if cfg.flash_attention and _on_tpu() else "auto",
+    )
+    if model_cfg.family == "llama":
+        return LlamaForCausalLM(**kwargs)
+    from relora_tpu.models.pythia import GPTNeoXForCausalLM
+
+    return GPTNeoXForCausalLM(**kwargs)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+class Trainer:
+    """End-to-end training driver.  Typical use::
+
+        trainer = Trainer(cfg)
+        trainer.fit(train_iter_factory, eval_iter_factory)
+    """
+
+    def __init__(
+        self,
+        cfg: TrainingConfig,
+        model_cfg: Optional[ModelConfig] = None,
+        mesh=None,
+    ):
+        cfg.finalize()
+        self.cfg = cfg
+        set_process_index(jax.process_index())
+
+        # ---- mesh / batch arithmetic -------------------------------------
+        self.mesh = mesh if mesh is not None else make_mesh(
+            MeshSpec(
+                data=cfg.dp_size if cfg.dp_size else -1,
+                fsdp=cfg.fsdp_size,
+                tensor=cfg.tp_size,
+                sequence=cfg.sp_size,
+            )
+        )
+        mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self.n_batch_shards = mesh_shape["data"] * mesh_shape["fsdp"]
+        self.grad_accum = cfg.grad_accum_for(self.n_batch_shards)
+        logger.info(
+            f"mesh={mesh_shape} grad_accum={self.grad_accum} "
+            f"global_microbatch={cfg.batch_size * self.n_batch_shards} "
+            f"total_batch={cfg.total_batch_size}"
+        )
+
+        # ---- model -------------------------------------------------------
+        if model_cfg is None:
+            model_cfg = load_model_config(cfg.model_config or cfg.model_name_or_path)
+        self.model_cfg = model_cfg
+        self.lora_spec = (
+            LoraSpec(
+                r=cfg.lora_r,
+                alpha=cfg.lora_alpha,
+                dropout=cfg.lora_dropout,
+                trainable_scaling=cfg.train_scaling,
+            )
+            if cfg.use_peft
+            else None
+        )
+        self.model = build_model(model_cfg, self.lora_spec, cfg)
+
+        sample = jnp.zeros((1, cfg.max_length), jnp.int32)
+        self.param_specs = logical_partition_specs(self.model, sample)
+        self.shardings = param_shardings(self.mesh, self.param_specs)
+        self.batch_shard = batch_sharding(self.mesh)
+
+        # ---- counters (may be overwritten by resume) ---------------------
+        self.update_step = 0
+        self.global_step = 0
+        self.tokens_seen = 0
+        self.tokens_seen_before = 0
+        self.n_lora_restarts = 0
+        self.n_optimizer_resets = 0
+        self._local_updates = 0
+        self._resumed = False
+        self._wandb_id: Optional[str] = None
+
+        # ---- resolve resume target (parity: torchrun_main.py:374-399) ----
+        self.resume_dir: Optional[str] = None
+        if cfg.autoresume and cfg.save_dir and os.path.isdir(cfg.save_dir):
+            training_state, self.resume_dir = ckpt.get_last_checkpoint(cfg.save_dir)
+            if self.resume_dir:
+                self._guard_batch_size_unchanged()
+        elif cfg.resume_from:
+            self.resume_dir = cfg.resume_from
+            self._guard_batch_size_unchanged()
+
+        # ---- params ------------------------------------------------------
+        init_rng = jax.random.PRNGKey(cfg.seed)
+        with self.mesh:
+            params = jax.jit(
+                lambda r: init_params(self.model, r, sample),
+                out_shardings=self.shardings,
+            )(init_rng)
+        counts = split_param_counts(params)
+        logger.info(
+            f"params: total={counts['total_params']/1e6:.2f}M "
+            f"trainable={counts['trainable_params']/1e6:.2f}M "
+            f"lora={counts['lora_params']/1e6:.2f}M "
+            f"equivalent={counts['equivalent_params']/1e6:.2f}M"
+        )
+        self.param_counts = counts
+
+        if cfg.warmed_up_model and not self.resume_dir:
+            params = self._load_warm_start(params, cfg.warmed_up_model)
+
+        # ---- optimizer + schedule ----------------------------------------
+        self.trainable_mask = trainable_param_mask(params)
+        if self.resume_dir:
+            ts = ckpt.load_training_state(self.resume_dir)
+            self.update_step = ts["update_step"]
+            self.global_step = ts["global_step"]
+            self.tokens_seen = ts["tokens_seen"]
+            self.tokens_seen_before = ts.get("tokens_seen_before", 0)
+            self.n_lora_restarts = ts.get("n_lora_restarts", 0)
+            self.n_optimizer_resets = ts.get("n_optimizer_resets", 0)
+            self._wandb_id = ts.get("wandb_id")
+            self._resumed = True
+            # Keep the schedule identical across restarts: restore the
+            # schedule origin instead of re-deriving it from the resume point
+            # (the reference re-derives, subtly reshaping the schedule on
+            # every autoresume — we persist it for bit-exact resume, the
+            # reference's own oracle (f) in SURVEY.md §4).
+            self.scheduler_start_step = ts.get("scheduler_start_step", self.update_step)
+        else:
+            if cfg.warmed_up_model:
+                ws = self._warm_start_counters(cfg.warmed_up_model)
+                if ws:
+                    self.update_step = ws.get("update_step", 0)
+                    self.global_step = ws.get("global_step", 0)
+                    self.tokens_seen = ws.get("tokens_seen", 0)
+            # scheduler runs over the remaining steps with a fresh first
+            # warmup (parity: torchrun_main.py:679-691)
+            self.scheduler_start_step = self.update_step
+
+        self.schedule = make_schedule(
+            cfg.scheduler,
+            lr=cfg.lr,
+            num_training_steps=cfg.num_training_steps - self.scheduler_start_step,
+            warmup_steps=cfg.warmup_steps,
+            min_lr_ratio=cfg.min_lr_ratio,
+            cycle_length=cfg.cycle_length or cfg.relora,
+            restart_warmup_steps=cfg.restart_warmup_steps,
+            adjust_step=cfg.adjust_step,
+        )
+        self.tx = build_optimizer(
+            schedule=self.schedule,
+            beta1=cfg.adam_beta1,
+            beta2=cfg.adam_beta2,
+            eps=cfg.adam_eps,
+            weight_decay=cfg.weight_decay,
+        )
+
+        with self.mesh:
+            trainable, _ = partition(params, self.trainable_mask)
+            opt_state = jax.jit(self.tx.init)(trainable)
+        self.state = TrainState.create(params, opt_state)
+        self.state = self.state.replace(step=jnp.asarray(self.update_step, jnp.int32))
+        self.state = self._normalize_placement(self.state)
+
+        if self.resume_dir and cfg.load_optimizer_state_on_resume:
+            self.state = self._normalize_placement(
+                ckpt.restore_checkpoint(self.resume_dir, self.state)
+            )
+            logger.info(f"Restored full train state from {self.resume_dir}")
+        elif self.resume_dir:
+            from relora_tpu.core.optim import set_schedule_count
+
+            restored = ckpt.restore_checkpoint(self.resume_dir, self.state)
+            self.state = self.state.replace(
+                params=restored.params,
+                # fresh optimizer, but the LR schedule continues from the
+                # checkpoint position (parity: scheduler replay,
+                # torchrun_main.py:693-699)
+                opt_state=set_schedule_count(
+                    self.state.opt_state, self.update_step - self.scheduler_start_step
+                ),
+            )
+            logger.info(f"Restored params (fresh optimizer) from {self.resume_dir}")
+
+        # ---- compiled programs -------------------------------------------
+        # metric LR is reported relative to the schedule origin, matching the
+        # optax-internal count (both freeze on NaN-skipped updates)
+        start = self.scheduler_start_step
+        self._train_step = jax.jit(
+            make_train_step(
+                self.model,
+                self.tx,
+                self.trainable_mask,
+                clip_grad_norm=cfg.clip_grad_norm,
+                schedule=lambda s: self.schedule(s - start),
+            ),
+            donate_argnums=0,
+        )
+        self._eval_step = jax.jit(make_eval_step(self.model))
+        if self.lora_spec is not None:
+            spec = self.lora_spec
+            self._merge_fn = jax.jit(
+                functools.partial(merge_and_reinit, spec=spec), donate_argnums=0
+            )
+        self._reset_fn = jax.jit(
+            functools.partial(
+                reset_optimizer_state,
+                mode=cfg.optimizer_reset_mode or "zero",
+                ratio=cfg.optimizer_reset_ratio,
+            ),
+            donate_argnums=0,
+        )
+
+        # ---- observability ----------------------------------------------
+        run_config = dict(cfg.to_dict())
+        run_config.update(
+            {
+                "model": model_cfg.to_dict(),
+                "mesh": mesh_shape,
+                "grad_accum": self.grad_accum,
+                **{k: v / 1e6 for k, v in counts.items()},
+            }
+        )
+        self.metrics = MetricsLogger(
+            run_dir=cfg.save_dir,
+            run_name=None,
+            config=run_config,
+            use_wandb=cfg.wandb,
+            resume_id=self._wandb_id,
+        )
+        self._wandb_id = self.metrics.run_id
+        if cfg.save_dir and jax.process_index() == 0:
+            os.makedirs(cfg.save_dir, exist_ok=True)
+            cfg.save(os.path.join(cfg.save_dir, "training_config.yaml"))
+
+    # ------------------------------------------------------------------
+    def _normalize_placement(self, tree: PyTree) -> PyTree:
+        """Ensure every leaf lives on this mesh's device set: leaves already
+        sharded over the full mesh are kept; stragglers (jit-placed or
+        checkpoint-restored scalars committed to one device) are replicated.
+        jit requires all arguments to share one device set."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh_devices = set(self.mesh.devices.flat)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def fix(leaf):
+            if not hasattr(leaf, "sharding"):
+                return leaf
+            try:
+                if set(leaf.sharding.device_set) == mesh_devices:
+                    return leaf
+            except Exception:
+                pass
+            return jax.device_put(leaf, rep)
+
+        return jax.tree_util.tree_map(fix, tree)
+
+    def _guard_batch_size_unchanged(self) -> None:
+        """Resume with a different batch size breaks the data rewind
+        (parity: torchrun_main.py:710-716)."""
+        import yaml
+
+        p = os.path.join(os.path.dirname(self.resume_dir), "training_config.yaml")
+        if not os.path.exists(p) and self.cfg.save_dir:
+            p = os.path.join(self.cfg.save_dir, "training_config.yaml")
+        if os.path.exists(p):
+            with open(p) as f:
+                old = yaml.safe_load(f)
+            if old.get("batch_size") != self.cfg.batch_size:
+                raise RuntimeError(
+                    "Cannot resume from a checkpoint with a different batch size"
+                )
+
+    def _load_warm_start(self, params: PyTree, path: str) -> PyTree:
+        """Full-rank weights into a (possibly LoRA) tree — the
+        full-rank→ReLoRA transition (torchrun_main.py:505-553)."""
+        from relora_tpu.models.hf_compat import graft_base_weights, hf_to_params
+
+        state_dir = os.path.join(path, ckpt.STATE_SUBDIR)
+        if os.path.isdir(state_dir):
+            # a previous run of ours (any shape — full-rank or LoRA):
+            # template-free host restore, then graft by name
+            base = ckpt.restore_params_host(path)
+        else:
+            bin_path = os.path.join(path, "pytorch_model.bin")
+            if not os.path.exists(bin_path):
+                raise ValueError(f"warmed_up_model {path!r} has neither state/ nor pytorch_model.bin")
+            import torch
+
+            sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+            base = hf_to_params(sd, self.model_cfg, scan_layers=True)
+        grafted = graft_base_weights(params, base)
+        logger.info(f"Warm-started base weights from {path}")
+        return grafted
+
+    def _warm_start_counters(self, path: str) -> Optional[dict]:
+        p = os.path.join(path, ckpt.TRAINING_STATE_FILE)
+        if os.path.exists(p):
+            import json
+
+            with open(p) as f:
+                return json.load(f)
+        logger.warning(f"No training state found in {path}; counters start from zero")
+        return None
+
+    # ------------------------------------------------------------------
+    def device_batch(self, local_batch: np.ndarray) -> jax.Array:
+        """Host numpy (ga, local_micro, seq) -> global sharded device array."""
+        if jax.process_count() == 1:
+            return jax.device_put(local_batch, self.batch_shard)
+        return jax.make_array_from_process_local_data(self.batch_shard, local_batch)
+
+    # ------------------------------------------------------------------
+    def fit(self, train_iter: Iterator[np.ndarray], eval_iter_factory=None) -> dict:
+        """The update loop (parity: torchrun_main.py:768-947)."""
+        cfg = self.cfg
+        exhausted = True  # for-else: did the data run out before the step budget?
+        update_start = time.time()
+        rng = jax.random.PRNGKey(cfg.seed + 1)
+        saved_at = -1
+        aborted = False
+
+        logger.info(
+            f"Starting training at update step {self.update_step} "
+            f"({cfg.num_training_steps - self.update_step} to go)"
+        )
+        for local_batch in train_iter:
+            if self.update_step >= cfg.num_training_steps:
+                exhausted = False
+                break
+            if self.update_step in cfg.skip_batches:
+                # manual loss-spike blacklist (torchrun_main.py:772-775)
+                self.update_step += 1
+                self.global_step += self.grad_accum
+                continue
+
+            batch = self.device_batch(local_batch)
+            n_tokens_global = batch.size
+            self.tokens_seen += int(n_tokens_global)
+
+            self.state, metrics = self._train_step(
+                self.state, batch, jax.random.fold_in(rng, self.update_step)
+            )
+            self.update_step += 1
+            self._local_updates += 1
+            self.global_step += self.grad_accum
+
+            if float(metrics["skipped"]):
+                logger.error(
+                    f"NaN update skipped at step {self.update_step} "
+                    f"({int(metrics['n_skipped'])} total)"
+                )
+                if int(metrics["n_skipped"]) > cfg.nan_abort_fraction * cfg.num_training_steps:
+                    logger.error("More than 5% of updates NaN-skipped; aborting")
+                    exhausted = False
+                    aborted = True
+                    break
+
+            # ---- save ----------------------------------------------------
+            if (
+                cfg.save_dir
+                and self._local_updates > 1
+                and self.update_step % cfg.save_every == 0
+            ):
+                self.save(time.time() - update_start)
+                saved_at = self.update_step
+
+            # ---- eval ----------------------------------------------------
+            if eval_iter_factory is not None and self.update_step % cfg.eval_every == 0:
+                eval_loss, eval_tokens = self.evaluate(
+                    eval_iter_factory(), cfg.eval_tokens_during_training
+                )
+                self.metrics.log(
+                    {"final_eval_loss": eval_loss, "final_eval_tokens": eval_tokens},
+                    step=self.global_step,
+                )
+                logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
+
+            # ---- ReLoRA merge (torchrun_main.py:874-893) ----------------
+            relora_every = cfg.relora
+            can_merge = relora_every is not None and (
+                self._resumed or self._local_updates >= relora_every
+            )
+            if can_merge and (self.update_step - self.scheduler_start_step) % relora_every == 1:
+                t0 = time.time()
+                self.n_lora_restarts += 1
+                self.state = self.state.replace(
+                    params=self._merge_fn(
+                        self.state.params,
+                        jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 2), self.update_step),
+                    )
+                )
+                jax.block_until_ready(self.state.params)
+                logger.info(
+                    f"LoRA merge #{self.n_lora_restarts} at update {self.update_step} "
+                    f"took {time.time() - t0:.2f}s"
+                )
+
+            # ---- optimizer reset (torchrun_main.py:895-912) -------------
+            cycle = cfg.cycle_length or cfg.relora
+            can_reset = cfg.relora is not None and cycle is not None and (
+                self._resumed or self._local_updates >= cycle
+            )
+            if can_reset and (self.update_step - self.scheduler_start_step) % cycle == 1:
+                self.n_optimizer_resets += 1
+                reset_rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 3), self.update_step)
+                self.state = self.state.replace(
+                    opt_state=self._reset_fn(self.state.opt_state, rng=reset_rng)
+                )
+                z = float(zeroed_fraction(self.state.opt_state))
+                logger.info(
+                    f"Optimizer reset #{self.n_optimizer_resets} "
+                    f"({cfg.optimizer_reset_mode}) at update {self.update_step}: "
+                    f"{z*100:.2f}% of moments zero"
+                )
+                # post-reset LR sanity (training_utils.py:391-404)
+                lr_now = float(self.schedule(jnp.asarray(self.update_step - self.scheduler_start_step)))
+                if lr_now > self.cfg.lr:
+                    self.metrics.alert(
+                        "Learning rate issue",
+                        f"LR after reset is {lr_now} > max {self.cfg.lr}",
+                    )
+
+            # ---- metrics (torchrun_main.py:918-943) ---------------------
+            update_time = time.time() - update_start
+            update_start = time.time()
+            tokens_in_update = self.tokens_seen - self.tokens_seen_before
+            self.tokens_seen_before = self.tokens_seen
+            self.metrics.log(
+                {
+                    "loss": float(metrics["loss"]),
+                    "lr": float(metrics.get("lr", 0.0)),
+                    "update_step": self.update_step,
+                    "tokens_seen": self.tokens_seen,
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "throughput_tokens": tokens_in_update / update_time,
+                    "throughput_examples": cfg.total_batch_size / update_time,
+                    "throughput_batches": self.grad_accum * self.n_batch_shards / update_time,
+                    "n_lora_restarts": self.n_lora_restarts,
+                    "n_optimizer_resets": self.n_optimizer_resets,
+                },
+                step=self.global_step,
+            )
+        if exhausted and self.update_step < cfg.num_training_steps:
+            # for-else equivalent (torchrun_main.py:945-947)
+            logger.warning("Reached the end of the dataset before num_training_steps")
+
+        # final save + eval (torchrun_main.py:956-1012)
+        if cfg.save_dir and self.update_step != saved_at:
+            self.save(time.time() - update_start)
+        result = {
+            "update_step": self.update_step,
+            "tokens_seen": self.tokens_seen,
+            "aborted": aborted,
+            "n_skipped": int(self.state.n_skipped),
+        }
+        if eval_iter_factory is not None:
+            final_loss, final_tokens = self.evaluate(eval_iter_factory(), target_tokens=100_000_000)
+            self.metrics.log(
+                {"final_eval_loss": final_loss, "final_eval_tokens": final_tokens},
+                step=self.global_step,
+            )
+            result["final_eval_loss"] = final_loss
+        self.metrics.finish()
+        logger.info("Training finished")
+        return result
+
+    # ------------------------------------------------------------------
+    def evaluate(self, eval_iter: Iterator[np.ndarray], target_tokens: int = -1):
+        """Token-weighted mean eval loss (parity: evaluate_model,
+        torchrun_main.py:143-189; target 10M during training, 100M final,
+        -1 = full set)."""
+        loss_sum = 0.0
+        n_tokens = 0.0
+        for arr in eval_iter:
+            out = self._eval_step(self.state.params, self.device_batch(arr))
+            loss_sum += float(out["loss_sum"])
+            n_tokens += float(out["n_tokens"])
+            if jnp.isnan(jnp.asarray(loss_sum)):
+                raise RuntimeError("NaN in evaluation loss")
+            if target_tokens > 0 and n_tokens >= target_tokens:
+                break
+        return loss_sum / max(n_tokens, 1.0), n_tokens
+
+    # ------------------------------------------------------------------
+    def save(self, update_time: float = 0.0) -> str:
+        training_state = {
+            "global_step": self.global_step,
+            "update_step": self.update_step,
+            "tokens_seen": self.tokens_seen,
+            "tokens_seen_before": self.tokens_seen_before,
+            "n_lora_restarts": self.n_lora_restarts,
+            "n_optimizer_resets": self.n_optimizer_resets,
+            "update_time": update_time,
+            "wandb_id": self._wandb_id,
+            # extension over the reference schema: lets resume rebuild the
+            # exact same LR schedule (see __init__)
+            "scheduler_start_step": self.scheduler_start_step,
+        }
+        path = ckpt.save_checkpoint(
+            self.cfg.save_dir,
+            self.update_step,
+            self.state,
+            training_state,
+            self.lora_spec,
+        )
+        ckpt.delete_old_checkpoints(self.cfg.save_dir, self.cfg.keep_checkpoints)
+        return path
