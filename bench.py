@@ -1,0 +1,100 @@
+"""Headline benchmark: ReLoRA training throughput on one TPU chip.
+
+Config mirrors BASELINE.md benchmark 2 scaled to a single chip: llama_250m,
+LoRA r=128, seq 512, bf16 compute, scan grad-accum train step.  Prints ONE
+JSON line::
+
+    {"metric": "...", "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is measured MFU / 0.5 — the reference repo publishes no
+throughput numbers (BASELINE.md), so the committed target is the north-star
+"≥50% MFU" from BASELINE.json; 1.0 means that target is met on this chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+MODEL = "llama_250m"
+MICRO_BATCH = 8
+GRAD_ACCUM = 2
+SEQ = 512
+WARMUP_STEPS = 3
+MEASURE_STEPS = 10
+
+# bf16 peak of one TPU v5e (v5 lite) chip
+PEAK_FLOPS = 197e12
+
+
+def main() -> None:
+    from relora_tpu.config.model import MODEL_ZOO
+    from relora_tpu.core.optim import build_optimizer
+    from relora_tpu.core.partition import partition
+    from relora_tpu.core.relora import LoraSpec, trainable_param_mask
+    from relora_tpu.models.llama import LlamaForCausalLM
+    from relora_tpu.models.params_util import init_params
+    from relora_tpu.train.state import TrainState
+    from relora_tpu.train.step import make_train_step
+
+    cfg = MODEL_ZOO[MODEL]
+    spec = LoraSpec(r=128, alpha=32, dropout=0.1)
+    model = LlamaForCausalLM(cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True)
+    sample = jnp.zeros((1, 8), jnp.int32)
+    params = init_params(model, jax.random.PRNGKey(0), sample)
+    mask = trainable_param_mask(params)
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    opt_state = jax.jit(tx.init)(partition(params, mask)[0])
+    state = TrainState.create(params, opt_state)
+    step = jax.jit(make_train_step(model, tx, mask), donate_argnums=0)
+
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (GRAD_ACCUM, MICRO_BATCH, SEQ), 0, cfg.vocab_size
+    )
+    rng = jax.random.PRNGKey(2)
+
+    for i in range(WARMUP_STEPS):
+        state, metrics = step(state, batch, jax.random.fold_in(rng, i))
+    float(metrics["loss"])  # full sync (block_until_ready can return early
+    # through the axon relay; a scalar pull cannot)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, metrics = step(state, batch, jax.random.fold_in(rng, 100 + i))
+    # the final loss depends on every preceding step's params, so this one
+    # sync forces the whole chain to have executed
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_update = GRAD_ACCUM * MICRO_BATCH * SEQ
+    tokens_per_sec = tokens_per_update * MEASURE_STEPS / dt
+
+    # 6*N per token fwd+bwd on the dense (equivalent) params
+    n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
+    flops_per_token = 6 * n_params
+    mfu = tokens_per_sec * flops_per_token / PEAK_FLOPS
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{MODEL} ReLoRA r=128 seq{SEQ} bf16 training throughput",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(mfu / 0.5, 4),
+                "detail": {
+                    "mfu": round(mfu, 4),
+                    "step_time_s": round(dt / MEASURE_STEPS, 4),
+                    "tokens_per_update": tokens_per_update,
+                    "loss": final_loss,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
