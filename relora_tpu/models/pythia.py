@@ -1,0 +1,210 @@
+"""TPU-native GPT-NeoX / Pythia decoder (Flax) with first-class LoRA leaves.
+
+Capability parity with the reference's modified HF GPT-NeoX
+(peft_pretraining/modeling_pythia.py): fused QKV ``query_key_value`` linear
+(:108), partial rotary embeddings (``rotary_pct``, :97, :184-197), parallel
+residual blocks (:443-456), LayerNorm with biases, GELU MLP, causal SDPA
+(:245-295), and a causal-LM head (:701-857).
+
+Used by the production 1B recipe (training_configs/1B_v1.0.yaml:
+EleutherAI/pythia-1b warm start).  Weight layout matches HF exactly — the
+fused QKV out-dim is interleaved per head as (heads, 3, head_dim) — so
+hf_compat transfers Pythia checkpoints without reshuffling.
+
+Same TPU-first choices as models/llama.py: scan-over-layers, optional remat,
+bf16 matmuls with f32 norms/rotary/softmax.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec
+from relora_tpu.models.llama import apply_rotary, rotary_tables
+from relora_tpu.models.lora import LoRALinear
+from relora_tpu.ops.attention import dot_product_attention
+
+
+class LayerNorm(nn.Module):
+    """f32 LayerNorm with bias (NeoX style)."""
+
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale + bias).astype(self.dtype)
+
+
+class NeoXAttention(nn.Module):
+    config: ModelConfig
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, cos, sin, deterministic: bool = True):
+        cfg = self.config
+        h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+        rot = cfg.rotary_dim
+
+        qkv = LoRALinear(
+            3 * h,
+            use_bias=True,
+            lora=self.lora,
+            dtype=self.dtype,
+            kernel_axes=("embed", "qkv"),
+            name="query_key_value",
+        )(x, deterministic)
+        B, S = x.shape[:2]
+        # HF NeoX fused layout: out dim is (heads, 3 * head_dim) interleaved
+        qkv = qkv.reshape(B, S, n, 3 * hd)
+        q, k, v = qkv[..., :hd], qkv[..., hd : 2 * hd], qkv[..., 2 * hd :]
+
+        # partial rotary: rotate the first rotary_dim dims, pass the rest
+        # (modeling_pythia.py:184-197)
+        q = jnp.concatenate([apply_rotary(q[..., :rot], cos, sin), q[..., rot:]], axis=-1)
+        k = jnp.concatenate([apply_rotary(k[..., :rot], cos, sin), k[..., rot:]], axis=-1)
+
+        out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
+        out = out.reshape(B, S, h)
+        return LoRALinear(
+            h,
+            use_bias=True,
+            lora=self.lora,
+            dtype=self.dtype,
+            kernel_axes=("qkv", "embed"),
+            name="dense",
+        )(out, deterministic)
+
+
+class NeoXMLP(nn.Module):
+    config: ModelConfig
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        dense = functools.partial(
+            LoRALinear, use_bias=True, lora=self.lora, dtype=self.dtype
+        )
+        y = dense(cfg.intermediate_size, kernel_axes=("embed", "mlp"), name="dense_h_to_4h")(
+            x, deterministic
+        )
+        y = nn.gelu(y, approximate=False)
+        return dense(cfg.hidden_size, kernel_axes=("mlp", "embed"), name="dense_4h_to_h")(
+            y, deterministic
+        )
+
+
+class NeoXLayer(nn.Module):
+    """Scan-compatible block; parallel residual by default
+    (modeling_pythia.py:443-456)."""
+
+    config: ModelConfig
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, cos, sin, deterministic: bool = True):
+        cfg = self.config
+        attn_in = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
+        attn_out = NeoXAttention(
+            cfg, self.lora, self.dtype, self.attention_impl, name="attention"
+        )(attn_in, cos, sin, deterministic)
+        mlp_in = LayerNorm(
+            eps=cfg.layer_norm_eps, dtype=self.dtype, name="post_attention_layernorm"
+        )(x if cfg.use_parallel_residual else x + attn_out)
+        mlp_out = NeoXMLP(cfg, self.lora, self.dtype, name="mlp")(mlp_in, deterministic)
+        if cfg.use_parallel_residual:
+            # x + attn(ln1(x)) + mlp(ln2(x))
+            return x + attn_out + mlp_out, None
+        return x + attn_out + mlp_out, None  # sequential: mlp_in already includes attn
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    """Causal LM with f32 logits (parity: modeling_pythia.py:701-857)."""
+
+    config: ModelConfig
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        positions: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=cfg.initializer_range), ("vocab", "embed")
+            ),
+            param_dtype=jnp.float32,
+            dtype=self.dtype,
+            name="embed_in",
+        )(input_ids)
+
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])[None, :]
+        cos, sin = rotary_tables(positions, cfg.rotary_dim, cfg.rotary_emb_base)
+
+        block = NeoXLayer
+        if self.remat:
+            block = nn.remat(block, prevent_cse=not self.scan_layers, static_argnums=(4,))
+        layer_kwargs = dict(
+            config=cfg, lora=self.lora, dtype=self.dtype, attention_impl=self.attention_impl
+        )
+        if self.scan_layers:
+            scanned = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, deterministic)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, deterministic)
+
+        x = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)
+        logits = LoRALinear(
+            cfg.vocab_size,
+            lora=None,
+            dtype=self.dtype,
+            kernel_axes=("embed", "vocab"),
+            name="embed_out",
+        )(x)
+        return logits.astype(jnp.float32)

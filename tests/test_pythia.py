@@ -1,0 +1,96 @@
+"""GPT-NeoX/Pythia model tests, incl. the differential oracle vs HF torch
+(systematizes notebook 11_test_pythia.ipynb — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec, lora_param_mask, merge_and_reinit
+from relora_tpu.models.params_util import init_params
+from relora_tpu.models.pythia import GPTNeoXForCausalLM
+
+TINY = ModelConfig(
+    family="neox",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=256,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+    rotary_pct=0.25,
+    use_parallel_residual=True,
+)
+
+
+def test_forward_shape():
+    model = GPTNeoXForCausalLM(TINY, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 256)
+    params = init_params(model, jax.random.PRNGKey(1), ids)
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, 256) and logits.dtype == jnp.float32
+
+
+def test_lora_targets_attention_and_mlp():
+    spec = LoraSpec(r=4, alpha=32)
+    model = GPTNeoXForCausalLM(TINY, lora=spec, dtype=jnp.float32)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = init_params(model, jax.random.PRNGKey(0), ids)
+    mask = lora_param_mask(params)
+    leaves = jax.tree_util.tree_flatten_with_path(mask)[0]
+    lora_paths = ["/".join(str(getattr(k, "key", k)) for k in p) for p, v in leaves if v]
+    # qkv + dense + 2 mlp denses = 4 modules x 2 leaves (stacked over layers)
+    assert len(lora_paths) == 8
+    assert all("attention" in p or "mlp" in p for p in lora_paths)
+    # merge works on the neox tree too
+    merged = merge_and_reinit(params, jax.random.PRNGKey(2), spec)
+    assert jax.tree_util.tree_structure(merged) == jax.tree_util.tree_structure(params)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("parallel_residual", [True, False])
+def test_against_hf_torch_neox(parallel_residual):
+    torch = pytest.importorskip("torch")
+    from transformers import GPTNeoXConfig as HFConfig
+    from transformers import GPTNeoXForCausalLM as HFNeoX
+
+    from relora_tpu.models.hf_compat import hf_to_params
+
+    cfg = ModelConfig(
+        family="neox",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=256,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        max_sequence_length=64,
+        rotary_pct=0.25,
+        use_parallel_residual=parallel_residual,
+    )
+    hf_cfg = HFConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        rotary_pct=cfg.rotary_pct,
+        rotary_emb_base=cfg.rotary_emb_base,
+        max_position_embeddings=cfg.max_sequence_length,
+        layer_norm_eps=cfg.layer_norm_eps,
+        use_parallel_residual=parallel_residual,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf_model = HFNeoX(hf_cfg).eval()
+    params = hf_to_params(hf_model.state_dict(), cfg, scan_layers=True)
+
+    ids_np = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids_np)).logits.numpy()
+
+    model = GPTNeoXForCausalLM(cfg, dtype=jnp.float32, scan_layers=True)
+    ours = model.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)}, jnp.asarray(ids_np)
+    )
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=2e-4, rtol=2e-3)
