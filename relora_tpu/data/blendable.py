@@ -1,0 +1,58 @@
+"""Weighted mixture over datasets (parity: megatron_dataset/blendable_dataset.py).
+
+The blend index (which dataset serves global sample i, and which of its local
+samples) is built by the greedy max-error interleave in C++
+(native/helpers.cpp), with a NumPy oracle for differential testing.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def build_blending_indices_py(weights: np.ndarray, size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """NumPy oracle of the greedy interleave (helpers.cpp parity)."""
+    weights = np.asarray(weights, dtype=np.float64)
+    taken = np.zeros(len(weights), dtype=np.int64)
+    dataset_index = np.zeros(size, dtype=np.uint8)
+    dataset_sample_index = np.zeros(size, dtype=np.int64)
+    for i in range(size):
+        position = max(float(i), 1.0)
+        errors = weights * position - taken
+        best = int(np.argmax(errors))
+        dataset_index[i] = best
+        dataset_sample_index[i] = taken[best]
+        taken[best] += 1
+    return dataset_index, dataset_sample_index
+
+
+class BlendableDataset:
+    """Mixture dataset honoring per-corpus weights (normalized)."""
+
+    def __init__(self, datasets: Sequence, weights: Sequence[float]):
+        if len(datasets) != len(weights):
+            raise ValueError("datasets and weights must align")
+        self.datasets = list(datasets)
+        w = np.asarray(weights, dtype=np.float64)
+        if (w <= 0).any():
+            raise ValueError("weights must be positive")
+        self.weights = w / w.sum()
+        self.size = int(sum(len(d) for d in datasets))
+
+        from relora_tpu.data.native import build_blending_indices_native
+
+        built = build_blending_indices_native(self.weights, self.size)
+        if built is None:
+            built = build_blending_indices_py(self.weights, self.size)
+        self.dataset_index, self.dataset_sample_index = built
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int):
+        d = int(self.dataset_index[idx])
+        s = int(self.dataset_sample_index[idx])
+        ds = self.datasets[d]
+        return ds[s % len(ds)]

@@ -1,0 +1,150 @@
+"""Memory-mapped indexed token storage, binary-compatible with the Megatron
+``.idx``/``.bin`` format.
+
+Capability parity with MMapIndexedDataset
+(peft_pretraining/megatron_dataset/indexed_dataset.py:348-565): zero-copy
+np.memmap reads, partial ``get(doc, offset, length)`` access, and a builder
+that autoselects uint16 for vocab < 65500 (:28-32).  Binary compatibility
+means existing corpora (e.g. the tokenized Pile the reference's production
+recipe points at) load unchanged.
+
+Format (one header + three arrays in ``.idx``, raw tokens in ``.bin``)::
+
+    magic   b"MMIDIDX\\x00\\x00"
+    version u64 = 1
+    dtype   u8 code (1 u8, 2 i8, 3 i16, 4 i32, 5 i64, 6 f32, 7 f64, 8 u16)
+    n_seqs  u64
+    n_docs  u64
+    sizes   i32[n_seqs]      tokens per sequence
+    ptrs    i64[n_seqs]      byte offset of each sequence in .bin
+    docs    i64[n_docs]      sequence index at each document boundary
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+_CODE_TO_DTYPE = {
+    1: np.uint8,
+    2: np.int8,
+    3: np.int16,
+    4: np.int32,
+    5: np.int64,
+    6: np.float32,
+    7: np.float64,
+    8: np.uint16,
+}
+_DTYPE_TO_CODE = {np.dtype(v): k for k, v in _CODE_TO_DTYPE.items()}
+
+
+def best_dtype(vocab_size: int) -> np.dtype:
+    """uint16 when the vocab fits (parity: indexed_dataset.py:28-32)."""
+    return np.dtype(np.uint16) if vocab_size is not None and vocab_size < 65500 else np.dtype(np.int32)
+
+
+def data_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MemmapTokenDataset:
+    """Read-only mmap view of a tokenized corpus.
+
+    ``self.sizes`` is the per-sequence token count; ``get(i, offset, length)``
+    returns a zero-copy slice of sequence ``i``'s tokens.
+    """
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        with open(index_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_path(prefix)}: bad magic {magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_CODE_TO_DTYPE[code])
+            (n_seqs,) = struct.unpack("<Q", f.read(8))
+            (n_docs,) = struct.unpack("<Q", f.read(8))
+            header_end = f.tell()
+
+        self._idx_map = np.memmap(index_path(prefix), mode="r", order="C")
+        off = header_end
+        self.sizes = np.frombuffer(self._idx_map, dtype=np.int32, count=n_seqs, offset=off)
+        off += n_seqs * 4
+        self.pointers = np.frombuffer(self._idx_map, dtype=np.int64, count=n_seqs, offset=off)
+        off += n_seqs * 8
+        self.doc_idx = np.frombuffer(self._idx_map, dtype=np.int64, count=n_docs, offset=off)
+        self._data = np.memmap(data_path(prefix), dtype=self.dtype, mode="r", order="C")
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        """Partial read of one sequence (parity: indexed_dataset.py:528-541)."""
+        size = int(self.sizes[idx])
+        if length is None:
+            length = size - offset
+        start = self.pointers[idx] // self.dtype.itemsize + offset
+        return self._data[start : start + length]
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        return self.get(idx)
+
+    @property
+    def n_tokens(self) -> int:
+        return int(self.sizes.sum())
+
+
+class MemmapTokenWriter:
+    """Streaming writer producing the same ``.idx``/``.bin`` pair
+    (parity: MMapIndexedDatasetBuilder, indexed_dataset.py:568-603)."""
+
+    def __init__(self, prefix: str, dtype: np.dtype = np.dtype(np.uint16)):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_TO_CODE:
+            raise ValueError(f"unsupported dtype {dtype}")
+        os.makedirs(os.path.dirname(os.path.abspath(prefix)), exist_ok=True)
+        self._bin = open(data_path(prefix), "wb")
+        self._sizes: list[int] = []
+        self._doc_ends: list[int] = [0]
+
+    def add_document(self, tokens) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(len(arr))
+        self._doc_ends.append(len(self._sizes))
+
+    def finalize(self) -> None:
+        self._bin.close()
+        sizes = np.asarray(self._sizes, dtype=np.int32)
+        pointers = np.zeros(len(sizes), dtype=np.int64)
+        np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        docs = np.asarray(self._doc_ends, dtype=np.int64)
+        with open(index_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_TO_CODE[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(docs)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(docs.tobytes(order="C"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finalize()

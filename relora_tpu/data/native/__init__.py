@@ -1,0 +1,123 @@
+"""Runtime build + ctypes bindings for the native index builders.
+
+Parity with the reference's runtime ``make`` hook
+(megatron_dataset/data_utils.py:470-482, Makefile): the shared object is
+compiled on first use with g++ and cached next to the source; if compilation
+fails (no compiler on some hosts) callers fall back to the NumPy
+implementations automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "helpers.cpp")
+_SO = os.path.join(_DIR, "_helpers.so")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _compile() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:
+        logger.warning(f"native helpers build failed ({e}); using NumPy fallbacks")
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the helpers library; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        src_mtime = os.path.getmtime(_SRC)
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+            if not _compile():
+                return None
+        lib = ctypes.CDLL(_SO)
+
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+        lib.relora_build_sample_idx_i32.argtypes = [
+            i32p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, i32p
+        ]
+        lib.relora_build_sample_idx_i32.restype = ctypes.c_int
+        lib.relora_build_sample_idx_i64.argtypes = [
+            i32p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, i64p
+        ]
+        lib.relora_build_sample_idx_i64.restype = ctypes.c_int
+        lib.relora_build_blending_indices.argtypes = [
+            u8p, i64p, f64p, ctypes.c_int32, ctypes.c_int64
+        ]
+        lib.relora_build_blending_indices.restype = None
+        lib.relora_shuffle_i64.argtypes = [i64p, ctypes.c_int64, ctypes.c_uint64]
+        lib.relora_shuffle_i64.restype = None
+        _LIB = lib
+        return _LIB
+
+
+def build_sample_idx_native(
+    sizes: np.ndarray, doc_idx: np.ndarray, seq_length: int, num_samples: int
+) -> Optional[np.ndarray]:
+    """C++ sample-index packing; None if the native lib is unavailable.
+    Uses int32 output when it fits (parity: dataset.py:189-203 dtype switch)."""
+    lib = load()
+    if lib is None:
+        return None
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    use_i32 = (
+        len(doc_idx) <= np.iinfo(np.int32).max
+        and int(sizes.max(initial=0)) <= np.iinfo(np.int32).max
+    )
+    if use_i32:
+        doc = np.ascontiguousarray(doc_idx, dtype=np.int32)
+        out = np.zeros((num_samples + 1, 2), dtype=np.int32)
+        rc = lib.relora_build_sample_idx_i32(
+            sizes, doc, len(doc), seq_length, num_samples, out.reshape(-1)
+        )
+    else:
+        doc = np.ascontiguousarray(doc_idx, dtype=np.int64)
+        out = np.zeros((num_samples + 1, 2), dtype=np.int64)
+        rc = lib.relora_build_sample_idx_i64(
+            sizes, doc, len(doc), seq_length, num_samples, out.reshape(-1)
+        )
+    if rc != 0:
+        raise ValueError(
+            "document list exhausted while packing samples — sizes/doc_idx "
+            "inconsistent with num_samples"
+        )
+    return out
+
+
+def build_blending_indices_native(
+    weights: np.ndarray, size: int
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    lib = load()
+    if lib is None:
+        return None
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    dataset_index = np.zeros(size, dtype=np.uint8)
+    dataset_sample_index = np.zeros(size, dtype=np.int64)
+    lib.relora_build_blending_indices(
+        dataset_index, dataset_sample_index, weights, len(weights), size
+    )
+    return dataset_index, dataset_sample_index

@@ -1,0 +1,142 @@
+// relora-tpu native dataset index builders.
+//
+// C++ equivalents of the reference's runtime-compiled pybind11 helpers
+// (peft_pretraining/megatron_dataset/helpers.cpp): the O(total_tokens) /
+// O(total_samples) index-construction loops that are too slow in Python for
+// billion-token corpora.  Re-implemented as a flat extern-C API loaded via
+// ctypes (pybind11 is not part of this toolchain); NumPy-owned buffers are
+// passed as raw pointers, so no copies are made in either direction.
+//
+// Differential-tested against the pure-NumPy implementations in
+// relora_tpu/data/sample_index.py and blendable.py (the same oracle strategy
+// the reference uses: dataset.py:275-320 is its Python fallback).
+//
+// Build: see native/build.py (g++ -O3 -shared -fPIC, no dependencies).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <random>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Sample-index packing (parity: helpers.cpp:91-259)
+//
+// Walk the (epoch-repeated, shuffled) document list, packing windows of
+// seq_length + 1 tokens; record the (position-in-doc_idx, offset-in-doc)
+// pair at each sample boundary.  The +1/-1 bookkeeping exists because
+// consecutive samples share one boundary token (input/target shift).
+//
+// sample_idx must hold 2 * (num_samples + 1) entries.  Returns 0 on success,
+// -1 if the documents ran out before num_samples were packed (corrupt input).
+// ---------------------------------------------------------------------------
+
+template <typename IndexT>
+static int pack_sample_index(const int32_t* sizes,
+                             const IndexT* doc_idx,
+                             int64_t doc_idx_len,
+                             int32_t seq_length,
+                             int64_t num_samples,
+                             IndexT* sample_idx) {
+  int64_t out = 0;
+  int64_t doc_pos = 0;     // index into doc_idx
+  int64_t doc_offset = 0;  // token offset within the current document
+
+  sample_idx[2 * out] = static_cast<IndexT>(doc_pos);
+  sample_idx[2 * out + 1] = static_cast<IndexT>(doc_offset);
+  ++out;
+
+  while (out <= num_samples) {
+    int64_t remaining = static_cast<int64_t>(seq_length) + 1;
+    while (remaining > 0) {
+      if (doc_pos >= doc_idx_len) return -1;
+      const int64_t doc_len = static_cast<int64_t>(sizes[doc_idx[doc_pos]]) - doc_offset;
+      if (doc_len >= remaining) {
+        // window ends inside this document; next sample re-reads the
+        // boundary token (hence the -1)
+        doc_offset += remaining - 1;
+        remaining = 0;
+      } else {
+        remaining -= doc_len;
+        ++doc_pos;
+        doc_offset = 0;
+      }
+    }
+    sample_idx[2 * out] = static_cast<IndexT>(doc_pos);
+    sample_idx[2 * out + 1] = static_cast<IndexT>(doc_offset);
+    ++out;
+  }
+  return 0;
+}
+
+static void fisher_yates_i64(int64_t* data, int64_t n, std::mt19937_64& rng) {
+  for (int64_t i = n - 1; i > 0; --i) {
+    std::uniform_int_distribution<int64_t> dist(0, i);
+    std::swap(data[i], data[dist(rng)]);
+  }
+}
+
+extern "C" {
+
+int relora_build_sample_idx_i32(const int32_t* sizes,
+                                const int32_t* doc_idx,
+                                int64_t doc_idx_len,
+                                int32_t seq_length,
+                                int64_t num_samples,
+                                int32_t* sample_idx) {
+  return pack_sample_index<int32_t>(sizes, doc_idx, doc_idx_len, seq_length,
+                                    num_samples, sample_idx);
+}
+
+int relora_build_sample_idx_i64(const int32_t* sizes,
+                                const int64_t* doc_idx,
+                                int64_t doc_idx_len,
+                                int32_t seq_length,
+                                int64_t num_samples,
+                                int64_t* sample_idx) {
+  return pack_sample_index<int64_t>(sizes, doc_idx, doc_idx_len, seq_length,
+                                    num_samples, sample_idx);
+}
+
+// ---------------------------------------------------------------------------
+// Weighted-blend index construction (parity: helpers.cpp:34-89)
+//
+// Greedy max-error interleave: at each global sample, emit the dataset whose
+// achieved count lags its target fraction the most.  dataset_index gets the
+// chosen dataset id; dataset_sample_index the running per-dataset counter.
+// ---------------------------------------------------------------------------
+
+void relora_build_blending_indices(uint8_t* dataset_index,
+                                   int64_t* dataset_sample_index,
+                                   const double* weights,
+                                   int32_t num_datasets,
+                                   int64_t size) {
+  std::vector<int64_t> taken(num_datasets, 0);
+  for (int64_t i = 0; i < size; ++i) {
+    const double position = std::max(static_cast<double>(i), 1.0);
+    int32_t best = 0;
+    double best_error = weights[0] * position - static_cast<double>(taken[0]);
+    for (int32_t d = 1; d < num_datasets; ++d) {
+      const double err = weights[d] * position - static_cast<double>(taken[d]);
+      if (err > best_error) {
+        best_error = err;
+        best = d;
+      }
+    }
+    dataset_index[i] = static_cast<uint8_t>(best);
+    dataset_sample_index[i] = taken[best];
+    ++taken[best];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// In-place Fisher-Yates shuffle (mirrors the shuffle the reference embeds in
+// its BERT mapping builders)
+// ---------------------------------------------------------------------------
+
+void relora_shuffle_i64(int64_t* data, int64_t n, uint64_t seed) {
+  std::mt19937_64 rng(seed);
+  fisher_yates_i64(data, n, rng);
+}
+
+}  // extern "C"
