@@ -66,6 +66,18 @@ def dot_product_attention(
         return jax.nn.dot_product_attention(q, k, v, scale=scale, is_causal=causal)
     if impl == "pallas":
         return _pallas_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "ring":
+        # context parallelism: S sharded over the mesh's sequence axis
+        from relora_tpu.parallel.mesh import current_mesh
+        from relora_tpu.parallel.ring_attention import ring_attention
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "attention impl 'ring' needs a mesh: call "
+                "relora_tpu.parallel.mesh.set_current_mesh(mesh) first"
+            )
+        return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
     if impl == "naive":
         return _naive_attention(q, k, v, causal=causal, scale=scale)
     raise ValueError(f"Unknown attention impl {impl!r}")

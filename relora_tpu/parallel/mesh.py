@@ -113,3 +113,20 @@ def shard_params(params: PyTree, shardings: PyTree) -> PyTree:
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, shardings
     )
+
+
+# ---------------------------------------------------------------------------
+# current-mesh registry: ops that need an explicit mesh (e.g. the ring
+# attention shard_map) read it here; the Trainer/driver sets it once.
+# ---------------------------------------------------------------------------
+
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH

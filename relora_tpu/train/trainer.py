@@ -64,13 +64,20 @@ _DTYPES = {
 
 def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingConfig):
     compute_dtype = _DTYPES[cfg.dtype]
+    if cfg.sp_size > 1:
+        # context parallelism: sequence sharded over the ring
+        attention_impl = "ring"
+    elif cfg.flash_attention and _on_tpu():
+        attention_impl = "pallas"
+    else:
+        attention_impl = "auto"
     kwargs = dict(
         config=model_cfg,
         lora=lora,
         dtype=compute_dtype,
         scan_layers=True,
         remat=cfg.remat,
-        attention_impl="pallas" if cfg.flash_attention and _on_tpu() else "auto",
+        attention_impl=attention_impl,
     )
     if model_cfg.family == "llama":
         return LlamaForCausalLM(**kwargs)
@@ -112,6 +119,9 @@ class Trainer:
                 sequence=cfg.sp_size,
             )
         )
+        from relora_tpu.parallel.mesh import set_current_mesh
+
+        set_current_mesh(self.mesh)
         mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         self.n_batch_shards = mesh_shape["data"] * mesh_shape["fsdp"]
         self.grad_accum = cfg.grad_accum_for(self.n_batch_shards)
@@ -140,7 +150,7 @@ class Trainer:
         sample = jnp.zeros((1, cfg.max_length), jnp.int32)
         self.param_specs = logical_partition_specs(self.model, sample)
         self.shardings = param_shardings(self.mesh, self.param_specs)
-        self.batch_shard = batch_sharding(self.mesh)
+        self.batch_shard = batch_sharding(self.mesh, seq_sharded=cfg.sp_size > 1)
 
         # ---- counters (may be overwritten by resume) ---------------------
         self.update_step = 0
