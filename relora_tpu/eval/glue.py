@@ -1,0 +1,214 @@
+"""GLUE fine-tuning of pretrained checkpoints — the run_glue.py engine.
+
+Capability parity with the reference's HF-Trainer-based harness
+(run_glue.py:209-623): task→sentence-keys map (:57-67), tokenize+pad,
+fine-tune ``LlamaForSequenceClassification`` (regression when the task is
+stsb), and compute the standard GLUE metrics.  The reference delegates the
+loop to transformers.Trainer and the metrics to ``evaluate``; here the loop
+is a small jitted train step (same machinery as pretraining) and the metrics
+are computed directly (accuracy / F1 / Matthews / Pearson / Spearman) so no
+extra dependencies are needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec
+from relora_tpu.models.llama import LlamaForSequenceClassification
+from relora_tpu.models.params_util import init_params
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# parity: run_glue.py:57-67
+TASK_TO_KEYS: Dict[str, Tuple[str, Optional[str]]] = {
+    "cola": ("sentence", None),
+    "mnli": ("premise", "hypothesis"),
+    "mrpc": ("sentence1", "sentence2"),
+    "qnli": ("question", "sentence"),
+    "qqp": ("question1", "question2"),
+    "rte": ("sentence1", "sentence2"),
+    "sst2": ("sentence", None),
+    "stsb": ("sentence1", "sentence2"),
+    "wnli": ("sentence1", "sentence2"),
+}
+
+TASK_NUM_LABELS = {
+    "cola": 2, "mnli": 3, "mrpc": 2, "qnli": 2, "qqp": 2,
+    "rte": 2, "sst2": 2, "stsb": 1, "wnli": 2,
+}
+
+
+# ---------------------------------------------------------------------------
+# metrics (no `evaluate` dependency)
+# ---------------------------------------------------------------------------
+
+
+def accuracy(preds: np.ndarray, labels: np.ndarray) -> float:
+    return float((preds == labels).mean())
+
+
+def f1_binary(preds: np.ndarray, labels: np.ndarray) -> float:
+    tp = float(((preds == 1) & (labels == 1)).sum())
+    fp = float(((preds == 1) & (labels == 0)).sum())
+    fn = float(((preds == 0) & (labels == 1)).sum())
+    if tp == 0:
+        return 0.0
+    precision = tp / (tp + fp)
+    recall = tp / (tp + fn)
+    return 2 * precision * recall / (precision + recall)
+
+
+def matthews_corr(preds: np.ndarray, labels: np.ndarray) -> float:
+    tp = float(((preds == 1) & (labels == 1)).sum())
+    tn = float(((preds == 0) & (labels == 0)).sum())
+    fp = float(((preds == 1) & (labels == 0)).sum())
+    fn = float(((preds == 0) & (labels == 1)).sum())
+    denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    return float((tp * tn - fp * fn) / denom) if denom > 0 else 0.0
+
+
+def pearson_corr(a: np.ndarray, b: np.ndarray) -> float:
+    a = a.astype(np.float64) - a.mean()
+    b = b.astype(np.float64) - b.mean()
+    denom = np.sqrt((a * a).sum() * (b * b).sum())
+    return float((a * b).sum() / denom) if denom > 0 else 0.0
+
+
+def spearman_corr(a: np.ndarray, b: np.ndarray) -> float:
+    rank = lambda x: np.argsort(np.argsort(x)).astype(np.float64)
+    return pearson_corr(rank(a), rank(b))
+
+
+def task_metrics(task: str, preds: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+    """The metric set evaluate.load("glue", task) would report
+    (parity: run_glue.py:496-501)."""
+    if task == "stsb":
+        return {
+            "pearson": pearson_corr(preds, labels),
+            "spearmanr": spearman_corr(preds, labels),
+        }
+    if task == "cola":
+        return {"matthews_correlation": matthews_corr(preds, labels)}
+    out = {"accuracy": accuracy(preds, labels)}
+    if task in ("mrpc", "qqp"):
+        out["f1"] = f1_binary(preds, labels)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fine-tuning engine
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GlueConfig:
+    task: str = "sst2"
+    lr: float = 2e-5
+    batch_size: int = 32
+    num_epochs: int = 3
+    max_length: int = 128
+    weight_decay: float = 0.01
+    warmup_ratio: float = 0.06
+    seed: int = 0
+    use_lora: bool = False
+    lora_r: int = 8
+
+
+def classification_loss(logits: jax.Array, labels: jax.Array, num_labels: int) -> jax.Array:
+    """CE for classification, MSE for regression (parity:
+    modeling_llama.py: regression when num_labels == 1)."""
+    if num_labels == 1:
+        return jnp.mean(jnp.square(logits[:, 0] - labels.astype(jnp.float32)))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def finetune(
+    model_cfg: ModelConfig,
+    gcfg: GlueConfig,
+    train_batches: Callable[[], Iterator[Tuple[np.ndarray, np.ndarray]]],
+    eval_batches: Callable[[], Iterator[Tuple[np.ndarray, np.ndarray]]],
+    steps_per_epoch: int,
+    pad_token_id: int = 0,
+    pretrained_backbone=None,
+) -> Dict[str, float]:
+    """Fine-tune and return the task metrics.
+
+    ``train_batches``/``eval_batches`` yield (input_ids, labels) numpy pairs.
+    ``pretrained_backbone`` is a causal-LM param tree (ours) whose base
+    weights are grafted under the classifier's ``model`` subtree — how a
+    ReLoRA-pretrained checkpoint is evaluated downstream.
+    """
+    num_labels = TASK_NUM_LABELS[gcfg.task]
+    lora = LoraSpec(r=gcfg.lora_r, alpha=2 * gcfg.lora_r, dropout=0.1) if gcfg.use_lora else None
+    model = LlamaForSequenceClassification(
+        model_cfg,
+        num_labels=num_labels,
+        pad_token_id=pad_token_id,
+        lora=lora,
+        dtype=jnp.float32,
+    )
+    sample = jnp.zeros((2, 8), jnp.int32)
+    params = init_params(model, jax.random.PRNGKey(gcfg.seed), sample)
+
+    if pretrained_backbone is not None:
+        from relora_tpu.models.hf_compat import graft_base_weights
+
+        backbone = {k: v for k, v in pretrained_backbone.items() if k != "lm_head"}
+        params = {**params, "model": graft_base_weights(params["model"], backbone)}
+        logger.info("grafted pretrained backbone into the classifier")
+
+    total_steps = steps_per_epoch * gcfg.num_epochs
+    schedule = optax.linear_schedule(0.0, gcfg.lr, max(1, int(total_steps * gcfg.warmup_ratio)))
+    decay = optax.linear_schedule(gcfg.lr, 0.0, max(1, total_steps - int(total_steps * gcfg.warmup_ratio)))
+    lr_fn = optax.join_schedules([schedule, decay], [int(total_steps * gcfg.warmup_ratio)])
+    tx = optax.adamw(lr_fn, weight_decay=gcfg.weight_decay)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, ids, labels, rng):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids, deterministic=False, rngs={"dropout": rng})
+            return classification_loss(logits, labels, num_labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def predict(params, ids):
+        return model.apply({"params": params}, ids, deterministic=True)
+
+    rng = jax.random.PRNGKey(gcfg.seed + 1)
+    step = 0
+    for epoch in range(gcfg.num_epochs):
+        for ids, labels in train_batches():
+            params, opt_state, loss = train_step(
+                params, opt_state, jnp.asarray(ids), jnp.asarray(labels),
+                jax.random.fold_in(rng, step),
+            )
+            step += 1
+        logger.info(f"epoch {epoch}: last train loss {float(loss):.4f}")
+
+    preds, labels_all = [], []
+    for ids, labels in eval_batches():
+        logits = predict(params, jnp.asarray(ids))
+        if num_labels == 1:
+            preds.append(np.asarray(logits)[:, 0])
+        else:
+            preds.append(np.argmax(np.asarray(logits), axis=-1))
+        labels_all.append(labels)
+    preds = np.concatenate(preds)
+    labels_all = np.concatenate(labels_all)
+    metrics = task_metrics(gcfg.task, preds, labels_all)
+    logger.info(f"{gcfg.task}: {metrics}")
+    return metrics

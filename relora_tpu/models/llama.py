@@ -159,6 +159,65 @@ class LlamaDecoderLayer(nn.Module):
         return x + m, None
 
 
+def decoder_stack(
+    module: nn.Module,
+    x: jax.Array,
+    positions: Optional[jax.Array],
+    deterministic: bool,
+    input_len: int,
+) -> jax.Array:
+    """Shared decoder body: rotary tables + (scanned or unrolled) layers +
+    final norm.  Called from inside a parent's @nn.compact, so submodules
+    ("layers"/"layers_i", "norm") register on the caller's scope — both heads
+    share one param layout."""
+    cfg = module.config
+    if positions is None:
+        positions = jnp.arange(input_len)[None, :]
+    cos, sin = rotary_tables(positions, cfg.head_dim, cfg.rotary_emb_base)
+
+    block = LlamaDecoderLayer
+    if module.remat:
+        block = nn.remat(
+            block,
+            prevent_cse=not module.scan_layers,
+            static_argnums=(4,),  # deterministic
+        )
+    layer_kwargs = dict(
+        config=cfg,
+        lora=module.lora,
+        dtype=module.dtype,
+        attention_impl=module.attention_impl,
+    )
+    if module.scan_layers:
+        scanned = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            length=cfg.num_hidden_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, deterministic)
+    else:
+        for i in range(cfg.num_hidden_layers):
+            x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, deterministic)
+    return RMSNorm(eps=cfg.rms_norm_eps, dtype=module.dtype, name="norm")(x)
+
+
+def token_embed(module: nn.Module, input_ids: jax.Array) -> jax.Array:
+    cfg = module.config
+    return nn.Embed(
+        cfg.vocab_size,
+        cfg.hidden_size,
+        embedding_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=cfg.initializer_range), ("vocab", "embed")
+        ),
+        param_dtype=jnp.float32,
+        dtype=module.dtype,
+        name="embed_tokens",
+    )(input_ids)
+
+
 class LlamaForCausalLM(nn.Module):
     """Causal LM returning f32 logits (parity: modeling_llama.py:603-757).
 
@@ -182,56 +241,70 @@ class LlamaForCausalLM(nn.Module):
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
     ) -> jax.Array:
-        cfg = self.config
-        embed = nn.Embed(
-            cfg.vocab_size,
-            cfg.hidden_size,
-            embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=cfg.initializer_range), ("vocab", "embed")
-            ),
-            param_dtype=jnp.float32,
-            dtype=self.dtype,
-            name="embed_tokens",
-        )
-        x = embed(input_ids)
-
-        if positions is None:
-            positions = jnp.arange(input_ids.shape[1])[None, :]
-        cos, sin = rotary_tables(positions, cfg.head_dim, cfg.rotary_emb_base)
-
-        block = LlamaDecoderLayer
-        if self.remat:
-            block = nn.remat(
-                block,
-                prevent_cse=not self.scan_layers,
-                static_argnums=(4,),  # deterministic
-            )
-        layer_kwargs = dict(
-            config=cfg,
-            lora=self.lora,
-            dtype=self.dtype,
-            attention_impl=self.attention_impl,
-        )
-        if self.scan_layers:
-            scanned = nn.scan(
-                block,
-                variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-                length=cfg.num_hidden_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, deterministic)
-        else:
-            for i in range(cfg.num_hidden_layers):
-                x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, deterministic)
-
-        x = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="norm")(x)
+        x = token_embed(self, input_ids)
+        x = decoder_stack(self, x, positions, deterministic, input_ids.shape[1])
         logits = LoRALinear(
-            cfg.vocab_size,
+            self.config.vocab_size,
             lora=None,  # lm_head is never LoRA-wrapped (target-module policy)
             dtype=self.dtype,
             kernel_axes=("embed", "vocab"),
             name="lm_head",
         )(x)
         return logits.astype(jnp.float32)
+
+
+class LlamaBackbone(nn.Module):
+    """Decoder stack without a head (shared by the classification model)."""
+
+    config: ModelConfig
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+        x = token_embed(self, input_ids)
+        return decoder_stack(self, x, positions, deterministic, input_ids.shape[1])
+
+
+class LlamaForSequenceClassification(nn.Module):
+    """Classification/regression head over the last non-pad token
+    (parity: modeling_llama.py:775-879 — bias-free ``score`` head, pooling at
+    the final non-padding position, regression when num_labels == 1)."""
+
+    config: ModelConfig
+    num_labels: int = 2
+    pad_token_id: Optional[int] = None
+    lora: Optional[LoraSpec] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        h = LlamaBackbone(
+            self.config,
+            lora=self.lora,
+            dtype=self.dtype,
+            scan_layers=self.scan_layers,
+            remat=self.remat,
+            attention_impl=self.attention_impl,
+            name="model",
+        )(input_ids, deterministic=deterministic)
+        logits = LoRALinear(
+            self.num_labels,
+            lora=None,
+            dtype=self.dtype,
+            kernel_axes=("embed", None),
+            name="score",
+        )(h)
+        if self.pad_token_id is None:
+            last = jnp.full((input_ids.shape[0],), input_ids.shape[1] - 1)
+        else:
+            not_pad = (input_ids != self.pad_token_id).astype(jnp.int32)
+            last = jnp.maximum(not_pad.sum(axis=-1) - 1, 0)
+        pooled = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0, :]
+        return pooled.astype(jnp.float32)

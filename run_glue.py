@@ -1,0 +1,112 @@
+"""GLUE fine-tuning CLI — the reference run_glue.py equivalent.
+
+Fine-tunes a (ReLoRA-)pretrained checkpoint on a GLUE task and reports the
+task metrics.  Example::
+
+    python run_glue.py --task_name sst2 --model_config llama_250m \
+        --checkpoint ckpts/relora/model_20000 --tokenizer t5-base \
+        --batch_size 32 --num_epochs 3 --max_length 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--task_name", required=True)
+    p.add_argument("--model_config", required=True)
+    p.add_argument("--checkpoint", default=None, help="relora-tpu checkpoint dir (model_N)")
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--num_epochs", type=int, default=3)
+    p.add_argument("--max_length", type=int, default=128)
+    p.add_argument("--weight_decay", type=float, default=0.01)
+    p.add_argument("--use_lora", default=False, type=lambda x: str(x).lower() == "true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_train_samples", type=int, default=None)
+    args = p.parse_args(argv)
+
+    import datasets
+    import numpy as np
+    from transformers import AutoTokenizer
+
+    from relora_tpu.config.model import load_model_config
+    from relora_tpu.eval.glue import GlueConfig, TASK_TO_KEYS, finetune
+
+    model_cfg = load_model_config(args.model_config)
+    gcfg = GlueConfig(
+        task=args.task_name,
+        lr=args.lr,
+        batch_size=args.batch_size,
+        num_epochs=args.num_epochs,
+        max_length=args.max_length,
+        weight_decay=args.weight_decay,
+        use_lora=args.use_lora,
+        seed=args.seed,
+    )
+
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    if tokenizer.pad_token_id is None:
+        tokenizer.pad_token = tokenizer.eos_token
+    key1, key2 = TASK_TO_KEYS[args.task_name]
+    raw = datasets.load_dataset("glue", args.task_name)
+    eval_split = "validation_matched" if args.task_name == "mnli" else "validation"
+
+    def encode(split, limit=None):
+        ds = raw[split]
+        if limit:
+            ds = ds.select(range(min(limit, len(ds))))
+        texts = (
+            list(zip(ds[key1], ds[key2])) if key2 is not None else ds[key1]
+        )
+        enc = tokenizer(
+            *( [ds[key1], ds[key2]] if key2 else [ds[key1]] ),
+            truncation=True,
+            max_length=args.max_length,
+            padding="max_length",
+        )
+        ids = np.asarray(enc["input_ids"], dtype=np.int32)
+        labels = np.asarray(ds["label"])
+        return ids, labels
+
+    train_ids, train_labels = encode("train", args.max_train_samples)
+    eval_ids, eval_labels = encode(eval_split)
+
+    bs = args.batch_size
+    steps_per_epoch = len(train_ids) // bs
+
+    def train_batches():
+        rs = np.random.RandomState(args.seed)
+        order = rs.permutation(len(train_ids))
+        for i in range(steps_per_epoch):
+            sel = order[i * bs : (i + 1) * bs]
+            yield train_ids[sel], train_labels[sel]
+
+    def eval_batches():
+        for i in range(0, len(eval_ids) - bs + 1, bs):
+            yield eval_ids[i : i + bs], eval_labels[i : i + bs]
+
+    pretrained = None
+    if args.checkpoint:
+        from relora_tpu.train.checkpoint import restore_params_host
+
+        pretrained = restore_params_host(args.checkpoint)
+
+    metrics = finetune(
+        model_cfg,
+        gcfg,
+        train_batches,
+        eval_batches,
+        steps_per_epoch,
+        pad_token_id=tokenizer.pad_token_id,
+        pretrained_backbone=pretrained,
+    )
+    print(json.dumps({"task": args.task_name, **metrics}))
+
+
+if __name__ == "__main__":
+    main()
