@@ -35,13 +35,15 @@ LORA_S = "lora_s"
 class LoraSpec:
     """Static LoRA hyperparameters needed by merge/init math.
 
-    Parity: ReLoRaConfig (relora.py:18-28) minus torch-specific fields.
+    Parity: ReLoRaConfig (relora.py:18-28); ``quantize`` selects int8 storage
+    for the frozen base (the bitsandbytes replacement — see ops/quant.py).
     """
 
     r: int
     alpha: float = 32.0
     dropout: float = 0.1
     trainable_scaling: bool = False
+    quantize: Optional[str] = None  # None | "int8"
 
     @property
     def scale(self) -> float:
@@ -92,7 +94,11 @@ def frozen_param_mask(params: PyTree) -> PyTree:
                 if isinstance(v, dict):
                     out[k] = walk(v)
                 else:
-                    out[k] = bool(has_lora and k == "kernel")
+                    # int8 codes/scales are never trainable regardless of LoRA
+                    out[k] = bool(
+                        (has_lora and k == "kernel")
+                        or k in ("kernel_q", "kernel_scale")
+                    )
             return out
         return False
 
@@ -192,9 +198,17 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
             return {k: walk(v) for k, v in node.items()}
         key = keys[next(key_iter)]
         out = dict(node)
-        kernel = node["kernel"]
-        merged = kernel.astype(jnp.float32) + lora_delta(node, spec)
-        out["kernel"] = merged.astype(kernel.dtype)
+        if "kernel_q" in node:
+            # int8 base: dequant -> add -> requant (parity with the 4-bit
+            # merge flow, relora.py:277-287)
+            from relora_tpu.ops.quant import dequantize_int8, quantize_int8
+
+            merged = dequantize_int8(node["kernel_q"], node["kernel_scale"]) + lora_delta(node, spec)
+            out["kernel_q"], out["kernel_scale"] = quantize_int8(merged)
+        else:
+            kernel = node["kernel"]
+            merged = kernel.astype(jnp.float32) + lora_delta(node, spec)
+            out["kernel"] = merged.astype(kernel.dtype)
         out[LORA_A] = kaiming_uniform(key, node[LORA_A].shape).astype(node[LORA_A].dtype)
         out[LORA_B] = jnp.zeros_like(node[LORA_B])
         if spec.trainable_scaling and LORA_S in node:

@@ -171,6 +171,16 @@ def graft_base_weights(params: PyTree, base: PyTree) -> PyTree:
         for k, v in b.items():
             if isinstance(v, Mapping):
                 out[k] = walk(p[k], v)
+            elif k == "kernel" and k not in p and "kernel_q" in p:
+                # int8 target: quantize the f32 source on the fly
+                from relora_tpu.ops.quant import quantize_int8
+
+                q, s = quantize_int8(jnp.asarray(v))
+                if p["kernel_q"].shape != q.shape:
+                    raise ValueError(
+                        f"shape mismatch for {k}: {p['kernel_q'].shape} vs {q.shape}"
+                    )
+                out["kernel_q"], out["kernel_scale"] = q, s
             else:
                 if p[k].shape != v.shape:
                     raise ValueError(f"shape mismatch for {k}: {p[k].shape} vs {v.shape}")

@@ -47,17 +47,53 @@ class LoRALinear(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: nn.initializers.Initializer = nn.initializers.normal(stddev=0.02)
     kernel_axes: Tuple[Optional[str], Optional[str]] = (None, None)
+    quantize: Optional[str] = None  # None | "int8" (frozen base only)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
         in_features = x.shape[-1]
-        kernel = self.param(
-            "kernel",
-            nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
-            (in_features, self.features),
-            self.param_dtype,
-        )
-        y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))
+        # quantization follows the LoRA spec (parity: quantize lives in
+        # ReLoRaConfig, relora.py:18-28) unless set explicitly
+        quantize = self.quantize or (self.lora.quantize if self.lora else None)
+        if quantize == "int8":
+            from relora_tpu.ops.quant import dequantize_int8
+
+            # Fresh init is W=0 (codes zero, scales one): a quantized base is
+            # only meaningful warm-started from real weights — exactly how the
+            # reference uses bitsandbytes (it quantizes the wrapped module's
+            # existing weight_data, relora.py:222-238).  Use
+            # hf_compat.graft_base_weights, which quantizes f32 sources on
+            # the fly.
+            def q_init(key, shape, dtype):
+                return jnp.zeros(shape, dtype)
+
+            def s_init(key, shape, dtype):
+                return jnp.ones(shape, dtype)
+
+            kernel_q = self.param(
+                "kernel_q",
+                nn.with_logical_partitioning(q_init, self.kernel_axes),
+                (in_features, self.features),
+                jnp.int8,
+            )
+            kernel_scale = self.param(
+                "kernel_scale",
+                nn.with_logical_partitioning(s_init, (None, self.kernel_axes[1])),
+                (1, self.features),
+                jnp.float32,
+            )
+            kernel = dequantize_int8(kernel_q, kernel_scale, self.dtype)
+            y = jnp.matmul(x.astype(self.dtype), kernel)
+        elif quantize is not None:
+            raise ValueError(f"Unknown quantize mode {quantize!r}")
+        else:
+            kernel = self.param(
+                "kernel",
+                nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
+                (in_features, self.features),
+                self.param_dtype,
+            )
+            y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))
         if self.use_bias:
             bias = self.param(
                 "bias",

@@ -1,0 +1,30 @@
+"""Int8 quantization for frozen base weights.
+
+TPU-native replacement for the reference's bitsandbytes 4/8-bit path
+(relora.py:10-11, 222-238): the frozen kernel is stored as int8 with an f32
+per-output-channel scale (symmetric absmax), halving its HBM footprint vs
+bf16 and quartering vs f32.  Forward dequantizes into the compute dtype —
+XLA fuses the dequant into the matmul epilogue — and merge-and-reinit does
+dequant → add ΔW → requant, the same flow as the reference's 4-bit merge
+(relora.py:277-287).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int8(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(..., in, out) float -> (int8 codes, f32 per-out-channel scales)."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)

@@ -141,6 +141,7 @@ class Trainer:
                 alpha=cfg.lora_alpha,
                 dropout=cfg.lora_dropout,
                 trainable_scaling=cfg.train_scaling,
+                quantize=cfg.quantize,
             )
             if cfg.use_peft
             else None

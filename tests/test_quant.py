@@ -1,0 +1,96 @@
+"""Int8 frozen-base tests: quantization accuracy, forward through a quantized
+LoRA model, dequant-add-requant merge, graft-time quantization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import (
+    LoraSpec,
+    frozen_param_mask,
+    merge_and_reinit,
+    trainable_param_mask,
+)
+from relora_tpu.models.hf_compat import graft_base_weights
+from relora_tpu.models.llama import LlamaForCausalLM
+from relora_tpu.models.params_util import init_params
+from relora_tpu.ops.quant import dequantize_int8, quantize_int8
+
+TINY = ModelConfig(
+    vocab_size=64,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=2,
+    max_sequence_length=32,
+)
+
+
+def test_quantize_roundtrip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    q, s = quantize_int8(w)
+    assert q.dtype == jnp.int8 and s.shape == (1, 32)
+    back = dequantize_int8(q, s)
+    err = jnp.abs(back - w).max() / jnp.abs(w).max()
+    assert float(err) < 0.01  # < 1% of the dynamic range per channel
+
+
+def test_quantized_model_forward_close_to_f32():
+    spec_q = LoraSpec(r=4, alpha=32, dropout=0.0, quantize="int8")
+    spec_f = LoraSpec(r=4, alpha=32, dropout=0.0)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+
+    f32_model = LlamaForCausalLM(TINY, lora=spec_f, dtype=jnp.float32)
+    f32_params = init_params(f32_model, jax.random.PRNGKey(0), ids)
+
+    q_model = LlamaForCausalLM(TINY, lora=spec_q, dtype=jnp.float32)
+    q_params = init_params(q_model, jax.random.PRNGKey(0), ids)
+    # quantized modules hold kernel_q/kernel_scale, no kernel
+    mod = q_params["layers"]["self_attn"]["q_proj"]
+    assert "kernel_q" in mod and "kernel_scale" in mod and "kernel" not in mod
+    # non-LoRA modules (lm_head) stay unquantized
+    assert "kernel" in q_params["lm_head"]
+
+    # graft the f32 base in (quantizing on the fly), outputs should be close
+    grafted = graft_base_weights(q_params, f32_params)
+    out_q = q_model.apply({"params": grafted}, ids)
+    out_f = f32_model.apply({"params": f32_params}, ids)
+    # logits differ only by int8 rounding of base kernels
+    assert float(jnp.abs(out_q - out_f).mean()) < 0.05
+
+
+def test_quantized_masks():
+    spec = LoraSpec(r=4, alpha=32, quantize="int8")
+    model = LlamaForCausalLM(TINY, lora=spec, dtype=jnp.float32)
+    params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    frozen = frozen_param_mask(params)
+    mod = frozen["layers"]["self_attn"]["q_proj"]
+    assert mod["kernel_q"] is True and mod["kernel_scale"] is True
+    train = trainable_param_mask(params)
+    tmod = train["layers"]["self_attn"]["q_proj"]
+    assert tmod["kernel_q"] is False and tmod["lora_a"] is True
+
+
+def test_quantized_merge_dequant_add_requant():
+    spec = LoraSpec(r=2, alpha=2, quantize="int8")
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (16, 16)) * 0.1
+    q, s = quantize_int8(w)
+    params = {
+        "m": {
+            "kernel_q": q,
+            "kernel_scale": s,
+            "lora_a": jax.random.normal(jax.random.fold_in(key, 1), (16, 2)) * 0.1,
+            "lora_b": jax.random.normal(jax.random.fold_in(key, 2), (2, 16)) * 0.1,
+        }
+    }
+    expected = dequantize_int8(q, s) + params["m"]["lora_a"] @ params["m"]["lora_b"]
+    out = merge_and_reinit(params, jax.random.PRNGKey(3), spec)
+    merged = dequantize_int8(out["m"]["kernel_q"], out["m"]["kernel_scale"])
+    # equal up to one int8 requantization
+    rel = float(jnp.abs(merged - expected).max() / jnp.abs(expected).max())
+    assert rel < 0.01
+    assert float(jnp.abs(out["m"]["lora_b"]).max()) == 0.0
+    assert out["m"]["kernel_q"].dtype == jnp.int8
