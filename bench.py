@@ -1,14 +1,18 @@
 """Headline benchmark: ReLoRA training throughput on one TPU chip.
 
-Config mirrors BASELINE.md benchmark 2 scaled to a single chip: llama_250m,
-LoRA r=128, seq 512, bf16 compute, scan grad-accum train step.  Prints ONE
-JSON line::
+Config mirrors BASELINE.md benchmark 3 scaled to a single chip: llama_1b,
+LoRA r=128 (the production 1B recipe's rank), seq 1024, bf16 compute,
+remat-over-scanned-layers, scan grad-accum train step.  Prints ONE JSON
+line::
 
     {"metric": "...", "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
 
 ``vs_baseline`` is measured MFU / 0.5 — the reference repo publishes no
 throughput numbers (BASELINE.md), so the committed target is the north-star
 "≥50% MFU" from BASELINE.json; 1.0 means that target is met on this chip.
+(Note: the sandbox's remote-compile tunnel rejects programs above a size
+threshold, which caps microbatch at 8 here; MFU counts only the 6N model
+FLOPs, so remat recompute deflates it.)
 """
 
 from __future__ import annotations
@@ -19,10 +23,11 @@ import time
 import jax
 import jax.numpy as jnp
 
-MODEL = "llama_250m"
+MODEL = "llama_1b"
 MICRO_BATCH = 8
-GRAD_ACCUM = 2
-SEQ = 512
+GRAD_ACCUM = 1
+SEQ = 1024
+REMAT = True
 WARMUP_STEPS = 3
 MEASURE_STEPS = 10
 
@@ -42,7 +47,9 @@ def main() -> None:
 
     cfg = MODEL_ZOO[MODEL]
     spec = LoraSpec(r=128, alpha=32, dropout=0.1)
-    model = LlamaForCausalLM(cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True)
+    model = LlamaForCausalLM(
+        cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True, remat=REMAT
+    )
     sample = jnp.zeros((1, 8), jnp.int32)
     params = init_params(model, jax.random.PRNGKey(0), sample)
     mask = trainable_param_mask(params)

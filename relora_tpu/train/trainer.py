@@ -406,6 +406,10 @@ class Trainer:
         saved_at = -1
         aborted = False
 
+        from relora_tpu.utils.profiling import maybe_make_profiler
+
+        prof = maybe_make_profiler(cfg, run_name=os.path.basename(cfg.save_dir or "run"))
+
         logger.info(
             f"Starting training at update step {self.update_step} "
             f"({cfg.num_training_steps - self.update_step} to go)"
@@ -527,6 +531,10 @@ class Trainer:
                 },
                 step=self.global_step,
             )
+            if prof is not None:
+                prof.step()
+        if prof is not None:
+            prof.stop()
         if exhausted and self.update_step < cfg.num_training_steps:
             # for-else equivalent (torchrun_main.py:945-947)
             logger.warning("Reached the end of the dataset before num_training_steps")

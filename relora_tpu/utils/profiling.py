@@ -1,0 +1,75 @@
+"""Profiling: XLA traces with the reference's schedule semantics.
+
+The reference builds a torch profiler with schedule (wait=1, warmup=1,
+active=3, repeat=2) writing TensorBoard traces per rank
+(torchrun_main.py:322-335), stepped each update (:944).  Here the same
+cadence drives ``jax.profiler`` trace windows: the trace captures XLA/TPU
+timelines viewable in TensorBoard or Perfetto.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class StepProfiler:
+    """Step-driven trace windows: wait W steps, warm up, record A steps,
+    repeat R times (parity: maybe_make_profiler, torchrun_main.py:322-335)."""
+
+    def __init__(
+        self,
+        log_dir: str,
+        *,
+        wait: int = 1,
+        warmup: int = 1,
+        active: int = 3,
+        repeat: int = 2,
+    ):
+        self.log_dir = os.path.abspath(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.wait = wait
+        self.warmup = warmup
+        self.active = active
+        self.repeat = max(1, repeat)
+        self._step = 0
+        self._cycles_done = 0
+        self._tracing = False
+
+    def step(self) -> None:
+        if self._cycles_done >= self.repeat:
+            return
+        cycle_len = self.wait + self.warmup + self.active
+        pos = self._step % cycle_len
+        record_start = self.wait + self.warmup
+        if pos == record_start and not self._tracing:
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+            logger.info(f"profiler: trace started -> {self.log_dir}")
+        self._step += 1
+        pos = self._step % cycle_len
+        if self._tracing and pos == 0:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._cycles_done += 1
+            logger.info(
+                f"profiler: trace {self._cycles_done}/{self.repeat} written"
+            )
+
+    def stop(self) -> None:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+
+def maybe_make_profiler(cfg, run_name: str = "run") -> Optional[StepProfiler]:
+    """None unless --profile true (parity: torchrun_main.py:322-335)."""
+    if not getattr(cfg, "profile", False):
+        return None
+    return StepProfiler(os.path.join("profiler_logs", run_name))
