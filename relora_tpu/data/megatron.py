@@ -212,16 +212,37 @@ def build_train_valid_test_iterators(cfg, trainer):
         )
 
     n_train = cfg.num_training_steps * cfg.total_batch_size
-    n_eval = (120_000_000 // mcfg.seq_length) + 1  # covers the 100M final eval
+    # eval sees each token at most once (one pass of the split), capped at
+    # what the 100M-token final eval needs (torchrun_main.py:984-987)
+    n_eval = (120_000_000 // mcfg.seq_length) + 1
     barrier = None
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         barrier = lambda: multihost_utils.sync_global_devices("megatron_index_build")
 
+    # cap each eval split at one pass of its own tokens: the packed dataset
+    # otherwise up-samples across epochs to satisfy any requested count, and
+    # a 100M-token final eval would loop a small split thousands of times
+    def one_pass_cap(split_tokens: int) -> int:
+        return max(1, min(n_eval, split_tokens // (mcfg.seq_length + 1)))
+
+    if mcfg.train_data_paths:
+        def paths_tokens(paths):
+            return sum(MemmapTokenDataset(p).n_tokens for p in paths) if paths else 0
+
+        valid_tokens = paths_tokens(mcfg.valid_data_paths)
+        test_tokens = paths_tokens(mcfg.test_data_paths)
+    else:
+        data = MemmapTokenDataset(mcfg.data_path)
+        sizes = np.asarray(data.sizes)
+        ranges = parse_split_string(mcfg.split, len(data))
+        valid_tokens = int(sizes[list(ranges[1])].sum()) if len(ranges[1]) else 0
+        test_tokens = int(sizes[list(ranges[2])].sum()) if len(ranges[2]) else 0
+
     train_ds, valid_ds, test_ds = build_split_datasets(
         mcfg,
-        (n_train, n_eval, n_eval),
+        (n_train, one_pass_cap(valid_tokens), one_pass_cap(test_tokens)),
         is_coordinator=jax.process_index() == 0,
         barrier=barrier,
     )

@@ -71,6 +71,16 @@ def load() -> Optional[ctypes.CDLL]:
         lib.relora_build_blending_indices.restype = None
         lib.relora_shuffle_i64.argtypes = [i64p, ctypes.c_int64, ctypes.c_uint64]
         lib.relora_shuffle_i64.restype = None
+        bert_args = [
+            i64p, ctypes.c_int64, i32p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_uint32,
+        ]
+        for fn in ("relora_count_bert_mapping", "relora_count_block_mapping"):
+            getattr(lib, fn).argtypes = list(bert_args)
+            getattr(lib, fn).restype = ctypes.c_int64
+        for fn in ("relora_fill_bert_mapping", "relora_fill_block_mapping"):
+            getattr(lib, fn).argtypes = list(bert_args) + [i64p]
+            getattr(lib, fn).restype = None
         _LIB = lib
         return _LIB
 
@@ -106,6 +116,44 @@ def build_sample_idx_native(
             "inconsistent with num_samples"
         )
     return out
+
+
+def build_bert_mapping(
+    docs: np.ndarray,
+    sizes: np.ndarray,
+    *,
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    short_seq_prob: float,
+    seed: int,
+    blocks: bool = False,
+) -> Optional[np.ndarray]:
+    """BERT-style span mapping (parity: helpers.cpp build_mapping :261-511).
+    Rows are (first_sentence, end_sentence, target_len), shuffled
+    deterministically by seed.
+
+    ``blocks=True`` adds the owning document index as column 3 —
+    (first_sentence, end_sentence, doc, target_len).  This serves the same
+    purpose as the reference's build_blocks_mapping (:513-747) but is NOT
+    bit-identical to it: the reference's block variant uses fixed per-doc
+    targets net of title sizes and records a block id; ours reuses the
+    short-seq sampling walk.  No training path consumes either."""
+    lib = load()
+    if lib is None:
+        return None
+    docs = np.ascontiguousarray(docs, dtype=np.int64)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    n_docs = len(docs) - 1
+    count_fn = lib.relora_count_block_mapping if blocks else lib.relora_count_bert_mapping
+    fill_fn = lib.relora_fill_block_mapping if blocks else lib.relora_fill_bert_mapping
+    args = (docs, n_docs, sizes, num_epochs, max_num_samples, max_seq_length, short_seq_prob, seed)
+    n = count_fn(*args)
+    cols = 4 if blocks else 3
+    maps = np.zeros((n, cols), dtype=np.int64)
+    if n:
+        fill_fn(*args, maps.reshape(-1))
+    return maps
 
 
 def build_blending_indices_native(

@@ -140,3 +140,147 @@ void relora_shuffle_i64(int64_t* data, int64_t n, uint64_t seed) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// BERT-style sentence-span mappings (parity: helpers.cpp:261-747).
+//
+// Documents are ranges of sentences: docs[d]..docs[d+1] index into `sizes`
+// (tokens per sentence).  Samples greedily pack consecutive sentences up to
+// a target length (occasionally shortened with prob `short_seq_prob`, the
+// reference's short_seq_ratio trick), skipping empty/one-sentence documents
+// and documents containing a sentence longer than `long_sentence_len`.
+//
+// Two-pass contract for a flat C API: `count` returns the number of samples
+// for a given epoch budget; `fill` re-runs the identical seeded walk to
+// populate the caller-allocated buffer, then Fisher-Yates shuffles rows.
+//
+// relora_*_bert_mapping rows: (first_sentence, end_sentence, target_len)
+// relora_*_block_mapping rows: (first_sentence, end_sentence, doc, target_len)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int32_t kLongSentenceLen = 512;
+
+inline int32_t target_sample_len(int32_t short_seq_ratio, int32_t max_length,
+                                 std::mt19937& rng) {
+  const uint32_t r = rng();
+  if (short_seq_ratio > 0 && (r % short_seq_ratio) == 0) {
+    return 2 + static_cast<int32_t>(r % (max_length - 1));
+  }
+  return max_length;
+}
+
+// One deterministic walk over epochs*documents; invokes emit(start, end, doc,
+// target_len) for every packed span.  Returns the number of spans visited
+// (bounded by max_num_samples).
+template <typename Emit>
+int64_t walk_spans(const int64_t* docs, int64_t n_docs, const int32_t* sizes,
+                   int32_t num_epochs, int64_t max_num_samples,
+                   int32_t max_seq_length, double short_seq_prob, uint32_t seed,
+                   Emit emit) {
+  const int32_t short_ratio =
+      short_seq_prob > 0 ? static_cast<int32_t>(std::lround(1.0 / short_seq_prob)) : 0;
+  std::mt19937 rng(seed);
+  int64_t emitted = 0;
+  for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+    if (emitted >= max_num_samples) break;
+    for (int64_t doc = 0; doc < n_docs; ++doc) {
+      const int64_t first = docs[doc];
+      const int64_t last = docs[doc + 1];
+      int64_t remaining = last - first;
+      if (remaining < 2) continue;  // empty/one-sentence docs are skipped
+      bool has_long = false;
+      for (int64_t s = first; s < last; ++s) {
+        if (sizes[s] > kLongSentenceLen) { has_long = true; break; }
+      }
+      if (has_long) continue;
+
+      int64_t span_start = first;
+      int32_t seq_len = 0;
+      int32_t num_sent = 0;
+      int32_t target = target_sample_len(short_ratio, max_seq_length, rng);
+      for (int64_t s = first; s < last; ++s) {
+        seq_len += sizes[s];
+        ++num_sent;
+        --remaining;
+        const bool full = seq_len >= target && remaining > 1 && num_sent > 1;
+        if (full || remaining == 0) {
+          emit(emitted, span_start, s + 1, doc, target);
+          ++emitted;
+          span_start = s + 1;
+          target = target_sample_len(short_ratio, max_seq_length, rng);
+          seq_len = 0;
+          num_sent = 0;
+        }
+      }
+    }
+  }
+  return emitted;
+}
+
+template <int kCols>
+void shuffle_rows(int64_t* maps, int64_t n, uint32_t seed) {
+  std::mt19937_64 rng(seed + 1);
+  for (int64_t i = n - 1; i > 0; --i) {
+    const int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
+    for (int c = 0; c < kCols; ++c) std::swap(maps[kCols * i + c], maps[kCols * j + c]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t relora_count_bert_mapping(const int64_t* docs, int64_t n_docs,
+                                  const int32_t* sizes, int32_t num_epochs,
+                                  int64_t max_num_samples, int32_t max_seq_length,
+                                  double short_seq_prob, uint32_t seed) {
+  return walk_spans(docs, n_docs, sizes, num_epochs, max_num_samples,
+                    max_seq_length, short_seq_prob, seed,
+                    [](int64_t, int64_t, int64_t, int64_t, int32_t) {});
+}
+
+void relora_fill_bert_mapping(const int64_t* docs, int64_t n_docs,
+                              const int32_t* sizes, int32_t num_epochs,
+                              int64_t max_num_samples, int32_t max_seq_length,
+                              double short_seq_prob, uint32_t seed,
+                              int64_t* maps) {
+  const int64_t n = walk_spans(
+      docs, n_docs, sizes, num_epochs, max_num_samples, max_seq_length,
+      short_seq_prob, seed,
+      [maps](int64_t i, int64_t start, int64_t end, int64_t, int32_t target) {
+        maps[3 * i] = start;
+        maps[3 * i + 1] = end;
+        maps[3 * i + 2] = target;
+      });
+  shuffle_rows<3>(maps, n, seed);
+}
+
+int64_t relora_count_block_mapping(const int64_t* docs, int64_t n_docs,
+                                   const int32_t* sizes, int32_t num_epochs,
+                                   int64_t max_num_samples, int32_t max_seq_length,
+                                   double short_seq_prob, uint32_t seed) {
+  return walk_spans(docs, n_docs, sizes, num_epochs, max_num_samples,
+                    max_seq_length, short_seq_prob, seed,
+                    [](int64_t, int64_t, int64_t, int64_t, int32_t) {});
+}
+
+void relora_fill_block_mapping(const int64_t* docs, int64_t n_docs,
+                               const int32_t* sizes, int32_t num_epochs,
+                               int64_t max_num_samples, int32_t max_seq_length,
+                               double short_seq_prob, uint32_t seed,
+                               int64_t* maps) {
+  const int64_t n = walk_spans(
+      docs, n_docs, sizes, num_epochs, max_num_samples, max_seq_length,
+      short_seq_prob, seed,
+      [maps](int64_t i, int64_t start, int64_t end, int64_t doc, int32_t target) {
+        maps[4 * i] = start;
+        maps[4 * i + 1] = end;
+        maps[4 * i + 2] = doc;
+        maps[4 * i + 3] = target;
+      });
+  shuffle_rows<4>(maps, n, seed);
+}
+
+}  // extern "C"

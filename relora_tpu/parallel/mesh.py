@@ -104,6 +104,13 @@ def batch_sharding(mesh: Mesh, seq_sharded: bool = False) -> NamedSharding:
     return NamedSharding(mesh, P(None, (DATA_AXIS, FSDP_AXIS)))
 
 
+def eval_batch_sharding(mesh: Mesh, seq_sharded: bool = False) -> NamedSharding:
+    """Sharding for a 2-D ``(batch, seq)`` eval array."""
+    if seq_sharded:
+        return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS), SEQUENCE_AXIS))
+    return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS)))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -95,13 +95,20 @@ def restore_checkpoint(path: str, abstract_state: PyTree) -> PyTree:
 
 def restore_params_host(path: str) -> PyTree:
     """Template-free restore of just the saved params subtree, as host numpy
-    arrays.  Used for warm starts, where the saved tree (e.g. full-rank, its
-    own optimizer) deliberately differs from the new run's state shape."""
+    arrays.  Used for warm starts and offline tools, where the saved tree
+    (e.g. full-rank, its own optimizer) deliberately differs from the new
+    run's state shape — and possibly from the current device topology, so
+    every leaf is forced to numpy instead of the recorded shardings."""
+    import numpy as np
     import orbax.checkpoint as ocp
 
-    restored = ocp.PyTreeCheckpointer().restore(
-        os.path.abspath(os.path.join(path, STATE_SUBDIR))
+    state_path = os.path.abspath(os.path.join(path, STATE_SUBDIR))
+    ckptr = ocp.PyTreeCheckpointer()
+    tree = ckptr.metadata(state_path).item_metadata.tree
+    restore_args = jax.tree_util.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
     )
+    restored = ckptr.restore(state_path, restore_args=restore_args)
     if isinstance(restored, Mapping) and "params" in restored:
         return restored["params"]
     return restored

@@ -42,6 +42,7 @@ from relora_tpu.models.params_util import init_params, logical_partition_specs
 from relora_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
+    eval_batch_sharding,
     make_mesh,
     param_shardings,
 )
@@ -152,6 +153,7 @@ class Trainer:
         self.param_specs = logical_partition_specs(self.model, sample)
         self.shardings = param_shardings(self.mesh, self.param_specs)
         self.batch_shard = batch_sharding(self.mesh, seq_sharded=cfg.sp_size > 1)
+        self.eval_batch_shard = eval_batch_sharding(self.mesh, seq_sharded=cfg.sp_size > 1)
 
         # ---- counters (may be overwritten by resume) ---------------------
         self.update_step = 0
@@ -391,10 +393,12 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def device_batch(self, local_batch: np.ndarray) -> jax.Array:
-        """Host numpy (ga, local_micro, seq) -> global sharded device array."""
+        """Host numpy -> global sharded device array.  3-D arrays are train
+        updates (ga, local_micro, seq); 2-D are eval batches (micro, seq)."""
+        shard = self.batch_shard if local_batch.ndim == 3 else self.eval_batch_shard
         if jax.process_count() == 1:
-            return jax.device_put(local_batch, self.batch_shard)
-        return jax.make_array_from_process_local_data(self.batch_shard, local_batch)
+            return jax.device_put(local_batch, shard)
+        return jax.make_array_from_process_local_data(shard, local_batch)
 
     # ------------------------------------------------------------------
     def fit(self, train_iter: Iterator[np.ndarray], eval_iter_factory=None) -> dict:

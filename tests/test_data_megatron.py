@@ -220,3 +220,38 @@ def test_yaml_config_accepts_reference_format(tmp_path):
     assert mcfg.seq_length == 16 and mcfg.train_data_paths == [prefix]
     train, valid, test = build_split_datasets(mcfg, (32, 8, 8))
     assert train[0]["input_ids"].shape == (17,)
+
+
+def test_bert_mapping_builders():
+    """BERT-style span builders: spans lie within documents, cover multiple
+    sentences, respect target lengths, deterministic by seed."""
+    from relora_tpu.data.native import build_bert_mapping
+
+    rs = np.random.RandomState(0)
+    # 20 docs x ~6 sentences of 5..60 tokens
+    sent_counts = rs.randint(2, 8, size=20)
+    docs = np.concatenate([[0], np.cumsum(sent_counts)]).astype(np.int64)
+    sizes = rs.randint(5, 60, size=int(docs[-1])).astype(np.int32)
+
+    kw = dict(num_epochs=2, max_num_samples=1000, max_seq_length=128,
+              short_seq_prob=0.1, seed=7)
+    maps = build_bert_mapping(docs, sizes, **kw)
+    assert maps is not None and maps.shape[1] == 3 and len(maps) > 0
+    # spans are sentence ranges inside some document
+    for start, end, target in maps[:50]:
+        assert 0 <= start < end <= docs[-1]
+        assert 2 <= target <= 128
+        # start/end within one document
+        d = np.searchsorted(docs, start, side="right") - 1
+        assert docs[d] <= start and end <= docs[d + 1]
+    # deterministic
+    maps2 = build_bert_mapping(docs, sizes, **kw)
+    np.testing.assert_array_equal(maps, maps2)
+    # different seed shuffles differently
+    maps3 = build_bert_mapping(docs, sizes, **{**kw, "seed": 8})
+    assert not np.array_equal(maps, maps3)
+
+    blocks = build_bert_mapping(docs, sizes, blocks=True, **kw)
+    assert blocks.shape[1] == 4
+    for start, end, d, target in blocks[:50]:
+        assert docs[d] <= start < end <= docs[d + 1]
