@@ -40,6 +40,9 @@ class ModelConfig:
     initializer_range: float = 0.02
     rotary_pct: float = 1.0  # neox partial rotary (modeling_pythia.py:97)
     rotary_emb_base: float = 10000.0
+    # context extension (parity: rope scaling variants, modeling_pythia.py:333-375)
+    rope_scaling_type: Optional[str] = None  # None | "linear" | "dynamic"
+    rope_scaling_factor: float = 1.0
     use_parallel_residual: bool = True  # neox (modeling_pythia.py:443-456)
     tie_word_embeddings: bool = False
     bos_token_id: int = 0
@@ -99,6 +102,8 @@ class ModelConfig:
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             bos_token_id=d.get("bos_token_id", 0),
             eos_token_id=d.get("eos_token_id", 1),
+            rope_scaling_type=(d.get("rope_scaling") or {}).get("type"),
+            rope_scaling_factor=(d.get("rope_scaling") or {}).get("factor", 1.0),
         )
 
 

@@ -44,6 +44,10 @@ class LoraSpec:
     dropout: float = 0.1
     trainable_scaling: bool = False
     quantize: Optional[str] = None  # None | "int8"
+    # pure-LoRA layers with no base weight at all (parity: lora_only,
+    # relora.py:209-211; selected when neither relora, force_keep_original
+    # nor a warm start needs the full kernel, torchrun_main.py:531-553)
+    lora_only: bool = False
 
     @property
     def scale(self) -> float:
@@ -197,6 +201,10 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
         if LORA_A not in node:
             return {k: walk(v) for k, v in node.items()}
         key = keys[next(key_iter)]
+        if "kernel" not in node and "kernel_q" not in node:
+            # lora_only module: nothing to merge into — skipped entirely,
+            # like the reference's warning-and-return (relora.py:271-273)
+            return dict(node)
         out = dict(node)
         if "kernel_q" in node:
             # int8 base: dequant -> add -> requant (parity with the 4-bit

@@ -53,16 +53,37 @@ class RMSNorm(nn.Module):
 
 
 def rotary_tables(
-    positions: jax.Array, head_dim: int, base: float = 10000.0
+    positions: jax.Array,
+    head_dim: int,
+    base: float = 10000.0,
+    *,
+    scaling_type: Optional[str] = None,
+    scaling_factor: float = 1.0,
+    max_position: Optional[int] = None,
+    current_length: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """cos/sin tables for HF-convention RoPE, f32, shape (..., seq, head_dim).
 
     Parity: the reference caches cos/sin up to max_seq and regrows on demand
     (modeling_llama.py:94-141); under jit, shapes are static so we just
     compute for the positions given — XLA folds this into the step.
+
+    Context extension (parity: rope scaling, modeling_pythia.py:333-375):
+    ``linear`` divides positions by the factor; ``dynamic`` (NTK) raises the
+    frequency base when the current length exceeds the trained max.  Both are
+    static under jit (lengths are shapes).
     """
+    pos = positions.astype(jnp.float32)
+    if scaling_type == "linear":
+        pos = pos / scaling_factor
+    elif scaling_type == "dynamic" and max_position and current_length and current_length > max_position:
+        base = base * (
+            scaling_factor * current_length / max_position - (scaling_factor - 1)
+        ) ** (head_dim / (head_dim - 2))
+    elif scaling_type not in (None, "linear", "dynamic"):
+        raise ValueError(f"Unknown rope scaling type {scaling_type!r}")
     inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    freqs = jnp.einsum("...s,d->...sd", positions.astype(jnp.float32), inv_freq)
+    freqs = jnp.einsum("...s,d->...sd", pos, inv_freq)
     emb = jnp.concatenate([freqs, freqs], axis=-1)
     return jnp.cos(emb), jnp.sin(emb)
 
@@ -173,7 +194,15 @@ def decoder_stack(
     cfg = module.config
     if positions is None:
         positions = jnp.arange(input_len)[None, :]
-    cos, sin = rotary_tables(positions, cfg.head_dim, cfg.rotary_emb_base)
+    cos, sin = rotary_tables(
+        positions,
+        cfg.head_dim,
+        cfg.rotary_emb_base,
+        scaling_type=cfg.rope_scaling_type,
+        scaling_factor=cfg.rope_scaling_factor,
+        max_position=cfg.max_sequence_length,
+        current_length=input_len,
+    )
 
     block = LlamaDecoderLayer
     if module.remat:

@@ -52,6 +52,9 @@ class LoRALinear(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
         in_features = x.shape[-1]
+        if self.lora is not None and self.lora.lora_only:
+            # pure-LoRA layer: no base weight, no bias (relora.py:209-211)
+            return self._lora_branch(x, in_features, deterministic)
         # quantization follows the LoRA spec (parity: quantize lives in
         # ReLoRaConfig, relora.py:18-28) unless set explicitly
         quantize = self.quantize or (self.lora.quantize if self.lora else None)
@@ -104,36 +107,40 @@ class LoRALinear(nn.Module):
             y = y + bias.astype(self.dtype)
 
         if self.lora is not None:
-            spec = self.lora
-            lora_a = self.param(
-                "lora_a",
-                nn.with_logical_partitioning(
-                    lambda key, shape, dtype: kaiming_uniform(key, shape, dtype),
-                    (self.kernel_axes[0], "lora"),
-                ),
-                (in_features, spec.r),
-                self.param_dtype,
-            )
-            lora_b = self.param(
-                "lora_b",
-                nn.with_logical_partitioning(
-                    nn.initializers.zeros_init(), ("lora", self.kernel_axes[1])
-                ),
-                (spec.r, self.features),
-                self.param_dtype,
-            )
-            h = x
-            if spec.dropout > 0.0 and not deterministic:
-                h = nn.Dropout(rate=spec.dropout, deterministic=False)(h)
-            z = jnp.matmul(h.astype(self.dtype), lora_a.astype(self.dtype))
-            z = jnp.matmul(z, lora_b.astype(self.dtype))
-            if spec.trainable_scaling:
-                lora_s = self.param(
-                    "lora_s", nn.initializers.ones_init(), (1,), self.param_dtype
-                )
-                # parity: trainable scaling passes through tanh (relora.py:263-267)
-                scale = jnp.tanh(lora_s.astype(self.dtype))
-            else:
-                scale = spec.scale
-            y = y + z * scale
+            y = y + self._lora_branch(x, in_features, deterministic)
         return y
+
+    def _lora_branch(self, x: jax.Array, in_features: int, deterministic: bool) -> jax.Array:
+        """((dropout(x) @ A) @ B) * scale (parity: relora.py:309-323)."""
+        spec = self.lora
+        lora_a = self.param(
+            "lora_a",
+            nn.with_logical_partitioning(
+                lambda key, shape, dtype: kaiming_uniform(key, shape, dtype),
+                (self.kernel_axes[0], "lora"),
+            ),
+            (in_features, spec.r),
+            self.param_dtype,
+        )
+        lora_b = self.param(
+            "lora_b",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("lora", self.kernel_axes[1])
+            ),
+            (spec.r, self.features),
+            self.param_dtype,
+        )
+        h = x
+        if spec.dropout > 0.0 and not deterministic:
+            h = nn.Dropout(rate=spec.dropout, deterministic=False)(h)
+        z = jnp.matmul(h.astype(self.dtype), lora_a.astype(self.dtype))
+        z = jnp.matmul(z, lora_b.astype(self.dtype))
+        if spec.trainable_scaling:
+            lora_s = self.param(
+                "lora_s", nn.initializers.ones_init(), (1,), self.param_dtype
+            )
+            # parity: trainable scaling passes through tanh (relora.py:263-267)
+            scale = jnp.tanh(lora_s.astype(self.dtype))
+        else:
+            scale = spec.scale
+        return z * scale

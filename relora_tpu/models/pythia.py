@@ -177,7 +177,15 @@ class GPTNeoXForCausalLM(nn.Module):
 
         if positions is None:
             positions = jnp.arange(input_ids.shape[1])[None, :]
-        cos, sin = rotary_tables(positions, cfg.rotary_dim, cfg.rotary_emb_base)
+        cos, sin = rotary_tables(
+            positions,
+            cfg.rotary_dim,
+            cfg.rotary_emb_base,
+            scaling_type=cfg.rope_scaling_type,
+            scaling_factor=cfg.rope_scaling_factor,
+            max_position=cfg.max_sequence_length,
+            current_length=input_ids.shape[1],
+        )
 
         block = NeoXLayer
         if self.remat:

@@ -136,6 +136,13 @@ class Trainer:
         if model_cfg is None:
             model_cfg = load_model_config(cfg.model_config or cfg.model_name_or_path)
         self.model_cfg = model_cfg
+        # base kernels are only materialized when something needs them
+        # (parity: need_linear_weight, torchrun_main.py:531-553)
+        need_linear_weight = (
+            cfg.relora is not None
+            or cfg.force_keep_original
+            or cfg.warmed_up_model is not None
+        )
         self.lora_spec = (
             LoraSpec(
                 r=cfg.lora_r,
@@ -143,6 +150,7 @@ class Trainer:
                 dropout=cfg.lora_dropout,
                 trainable_scaling=cfg.train_scaling,
                 quantize=cfg.quantize,
+                lora_only=not need_linear_weight,
             )
             if cfg.use_peft
             else None

@@ -174,3 +174,57 @@ def test_against_hf_torch_llama():
     model = LlamaForCausalLM(TINY, dtype=jnp.float32, scan_layers=True)
     ours = model.apply({"params": jax.tree_util.tree_map(jnp.asarray, params)}, jnp.asarray(ids_np))
     np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=2e-4, rtol=2e-3)
+
+
+def test_rope_scaling_variants():
+    """linear / dynamic-NTK rope scaling (parity: modeling_pythia.py:333-375)."""
+    from relora_tpu.models.llama import rotary_tables
+
+    pos = jnp.arange(16)[None, :]
+    base_cos, _ = rotary_tables(pos, 16)
+    lin_cos, _ = rotary_tables(pos, 16, scaling_type="linear", scaling_factor=2.0)
+    # linear scaling at factor 2 equals halved positions
+    half_cos, _ = rotary_tables(pos / 2, 16)
+    np.testing.assert_allclose(np.asarray(lin_cos), np.asarray(half_cos), atol=1e-6)
+    # dynamic NTK only kicks in beyond the trained max
+    dyn_short, _ = rotary_tables(pos, 16, scaling_type="dynamic", scaling_factor=2.0,
+                                 max_position=32, current_length=16)
+    np.testing.assert_allclose(np.asarray(dyn_short), np.asarray(base_cos), atol=1e-6)
+    dyn_long, _ = rotary_tables(pos, 16, scaling_type="dynamic", scaling_factor=2.0,
+                                max_position=8, current_length=16)
+    assert not np.allclose(np.asarray(dyn_long), np.asarray(base_cos))
+    with pytest.raises(ValueError, match="scaling type"):
+        rotary_tables(pos, 16, scaling_type="ntk")
+    # models accept the config fields
+    cfg = ModelConfig(**{**TINY.to_dict(), "rope_scaling_type": "linear",
+                         "rope_scaling_factor": 2.0})
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    from relora_tpu.models.params_util import init_params
+    params = init_params(model, jax.random.PRNGKey(0), ids)
+    assert model.apply({"params": params}, ids).shape == (1, 8, cfg.vocab_size)
+
+
+def test_lora_only_mode():
+    """Pure-LoRA layers: no kernel leaf, forward is the LoRA branch alone,
+    merge skips them (parity: relora.py:209-211, 271-273)."""
+    from relora_tpu.core.relora import trainable_param_mask
+
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0, lora_only=True)
+    model = LlamaForCausalLM(TINY, lora=spec, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 256)
+    from relora_tpu.models.params_util import init_params
+    params = init_params(model, jax.random.PRNGKey(1), ids)
+    q = params["layers"]["self_attn"]["q_proj"]
+    assert "kernel" not in q and "lora_a" in q
+    # everything that exists is trainable
+    mask = trainable_param_mask(params)
+    assert all(jax.tree_util.tree_leaves(mask))
+    out = model.apply({"params": params}, ids)
+    assert out.shape == (2, 16, 256)
+    # merge leaves lora_only modules untouched
+    merged = merge_and_reinit(params, jax.random.PRNGKey(2), spec)
+    np.testing.assert_array_equal(
+        np.asarray(merged["layers"]["self_attn"]["q_proj"]["lora_a"]),
+        np.asarray(q["lora_a"]),
+    )
