@@ -73,6 +73,11 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     sys.path.insert(0, ".")
+    import jax
+
+    # offline tool: host CPU is all we need, and restoring through a TPU
+    # tunnel backend can stall
+    jax.config.update("jax_platforms", "cpu")
     from relora_tpu.train.checkpoint import restore_params_host
 
     before = restore_params_host(args.before)
