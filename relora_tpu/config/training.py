@@ -112,7 +112,9 @@ class TrainingConfig:
     tp_size: int = 1  # tensor axis
     sp_size: int = 1  # sequence (ring attention / context parallel) axis
     remat: bool = False  # gradient checkpointing on decoder layers
-    flash_attention: bool = True  # pallas kernel when on TPU
+    # opt-in pallas flash kernel: XLA's fused attention is the robust default
+    # (and the sandbox's remote-compile tunnel stalls on the pallas kernel)
+    flash_attention: bool = False
 
     # --- observability / misc ---
     profile: bool = False

@@ -1,0 +1,82 @@
+"""Export a relora-tpu checkpoint as an HF-format torch model directory.
+
+The LoRA factors are merged into the base weights first (the equivalent
+full-rank model, core.relora.merged_params), so the output loads directly
+into transformers' LlamaForCausalLM / GPTNeoXForCausalLM — the path by which
+ReLoRA-pretrained models reach downstream HF tooling (the reference does
+this through wrapped_model.save_pretrained, relora.py:149-152).
+
+Usage::
+
+    python tools/export_hf.py --checkpoint ckpts/relora/model_20000 \
+        --model_config llama_250m --out export/llama_250m_relora
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("--model_config", required=True)
+    p.add_argument("--out", required=True)
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, ".")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from relora_tpu.config.model import load_model_config
+    from relora_tpu.core.relora import LoraSpec, merged_params
+    from relora_tpu.models.hf_compat import params_to_hf
+    from relora_tpu.train.checkpoint import load_lora_spec, restore_params_host
+
+    cfg = load_model_config(args.model_config)
+    params = restore_params_host(args.checkpoint)
+    spec = load_lora_spec(args.checkpoint)
+    if spec is not None:
+        params = jax.tree_util.tree_map(np.asarray, merged_params(params, spec))
+        print(f"merged LoRA factors (r={spec.r}) into base weights")
+
+    sd = params_to_hf(params, cfg)
+    os.makedirs(args.out, exist_ok=True)
+
+    import torch
+
+    torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+               os.path.join(args.out, "pytorch_model.bin"))
+    hf_config = {
+        "architectures": ["LlamaForCausalLM" if cfg.family == "llama" else "GPTNeoXForCausalLM"],
+        "model_type": "llama" if cfg.family == "llama" else "gpt_neox",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_hidden_layers,
+        "num_attention_heads": cfg.num_attention_heads,
+        "num_key_value_heads": cfg.num_attention_heads,
+        "max_position_embeddings": cfg.max_sequence_length,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "layer_norm_eps": cfg.layer_norm_eps,
+        "rotary_pct": cfg.rotary_pct,
+        "rope_theta": cfg.rotary_emb_base,
+        "use_parallel_residual": cfg.use_parallel_residual,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "bos_token_id": cfg.bos_token_id,
+        "eos_token_id": cfg.eos_token_id,
+        "torch_dtype": "float32",
+    }
+    with open(os.path.join(args.out, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=2)
+    n = sum(v.size for v in sd.values())
+    print(f"wrote {len(sd)} tensors ({n/1e6:.1f}M params) to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
