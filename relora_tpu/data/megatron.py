@@ -164,7 +164,12 @@ class PackedBatchIterator:
     """Batches a random-access packed dataset into device-ready arrays with
     deterministic per-host slicing and update-step rewind (parity:
     DistributedBatchSampler + start_iter, samplers.py:88-165,
-    data_utils.py:443-466)."""
+    data_utils.py:443-466).
+
+    ``interleaved=False`` gives each host a contiguous run of the global
+    batch; ``True`` stripes hosts across it (the reference supports both
+    slicings, samplers.py:159-165).
+    """
 
     def __init__(
         self,
@@ -175,12 +180,14 @@ class PackedBatchIterator:
         skip_updates: int = 0,
         process_index: int = 0,
         process_count: int = 1,
+        interleaved: bool = False,
     ):
         self.dataset = dataset
         self.microbatch = microbatch
         self.grad_accum = grad_accum
         self.process_index = process_index
         self.process_count = process_count
+        self.interleaved = interleaved
         self._per_update = microbatch * (grad_accum or 1) * process_count
         self._start = skip_updates * self._per_update
         self._n_updates = len(dataset) // self._per_update
@@ -188,12 +195,18 @@ class PackedBatchIterator:
     def __len__(self) -> int:
         return max(0, self._n_updates - self._start // self._per_update)
 
+    def _host_rows(self, start: int, per_host: int) -> list:
+        if self.interleaved:
+            idxs = range(start + self.process_index, start + self._per_update, self.process_count)
+        else:
+            lo = start + self.process_index * per_host
+            idxs = range(lo, lo + per_host)
+        return [self.dataset[i]["input_ids"] for i in idxs]
+
     def __iter__(self) -> Iterator[np.ndarray]:
         per_host = self.microbatch * (self.grad_accum or 1)
         for start in range(self._start, self._n_updates * self._per_update, self._per_update):
-            lo = start + self.process_index * per_host
-            rows = [self.dataset[lo + j]["input_ids"] for j in range(per_host)]
-            arr = np.asarray(rows, dtype=np.int32)
+            arr = np.asarray(self._host_rows(start, per_host), dtype=np.int32)
             if self.grad_accum is None:
                 yield arr
             else:
