@@ -426,6 +426,49 @@ class Trainer:
             f"Starting training at update step {self.update_step} "
             f"({cfg.num_training_steps - self.update_step} to go)"
         )
+        # Metrics are materialized with a one-step lag: float()-ing the
+        # current step's device metrics would block the host on the step's
+        # completion every iteration (costly through a TPU tunnel); by
+        # logging the previous step's metrics while the current one computes,
+        # data loading and logging overlap device work.  The NaN-abort check
+        # therefore also lags one update — one extra step before an abort is
+        # harmless.
+        pending = None  # (metrics, update_step, global_step)
+
+        def flush_pending() -> bool:
+            """Log the lagged metrics; returns False if training must abort."""
+            nonlocal pending
+            if pending is None:
+                return True
+            metrics, at_step, at_global, tokens_in_update, dt = pending
+            pending = None
+            if float(metrics["skipped"]):
+                logger.error(
+                    f"NaN update skipped at step {at_step} "
+                    f"({int(metrics['n_skipped'])} total)"
+                )
+                if int(metrics["n_skipped"]) > cfg.nan_abort_fraction * cfg.num_training_steps:
+                    logger.error("More than 5% of updates NaN-skipped; aborting")
+                    return False
+            self.metrics.log(
+                {
+                    "loss": float(metrics["loss"]),
+                    "lr": float(metrics.get("lr", 0.0)),
+                    "update_step": at_step,
+                    "tokens_seen": self.tokens_seen,
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "throughput_tokens": tokens_in_update / dt,
+                    "throughput_examples": cfg.total_batch_size / dt,
+                    "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
+                    "n_lora_restarts": self.n_lora_restarts,
+                    "n_optimizer_resets": self.n_optimizer_resets,
+                },
+                step=at_global,
+            )
+            if prof is not None:
+                prof.step()
+            return True
+
         for local_batch in train_iter:
             if self.update_step >= cfg.num_training_steps:
                 exhausted = False
@@ -446,17 +489,6 @@ class Trainer:
             self.update_step += 1
             self._local_updates += 1
             self.global_step += self.grad_accum
-
-            if float(metrics["skipped"]):
-                logger.error(
-                    f"NaN update skipped at step {self.update_step} "
-                    f"({int(metrics['n_skipped'])} total)"
-                )
-                if int(metrics["n_skipped"]) > cfg.nan_abort_fraction * cfg.num_training_steps:
-                    logger.error("More than 5% of updates NaN-skipped; aborting")
-                    exhausted = False
-                    aborted = True
-                    break
 
             # ---- save ----------------------------------------------------
             if (
@@ -523,28 +555,18 @@ class Trainer:
                         f"LR after reset is {lr_now} > max {self.cfg.lr}",
                     )
 
-            # ---- metrics (torchrun_main.py:918-943) ---------------------
+            # ---- metrics (torchrun_main.py:918-943), one-step lagged -----
+            if not flush_pending():
+                exhausted = False
+                aborted = True
+                break
             update_time = time.time() - update_start
             update_start = time.time()
             tokens_in_update = self.tokens_seen - self.tokens_seen_before
             self.tokens_seen_before = self.tokens_seen
-            self.metrics.log(
-                {
-                    "loss": float(metrics["loss"]),
-                    "lr": float(metrics.get("lr", 0.0)),
-                    "update_step": self.update_step,
-                    "tokens_seen": self.tokens_seen,
-                    "grad_norm": float(metrics["grad_norm"]),
-                    "throughput_tokens": tokens_in_update / update_time,
-                    "throughput_examples": cfg.total_batch_size / update_time,
-                    "throughput_batches": self.grad_accum * self.n_batch_shards / update_time,
-                    "n_lora_restarts": self.n_lora_restarts,
-                    "n_optimizer_resets": self.n_optimizer_resets,
-                },
-                step=self.global_step,
-            )
-            if prof is not None:
-                prof.step()
+            pending = (metrics, self.update_step, self.global_step, tokens_in_update, update_time)
+        if not flush_pending():
+            aborted = True
         if prof is not None:
             prof.stop()
         if exhausted and self.update_step < cfg.num_training_steps:

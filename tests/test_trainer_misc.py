@@ -1,0 +1,92 @@
+"""Trainer auxiliaries: skip_batches, NaN abort threshold, lagged metrics,
+profiler cadence, metrics logger."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.test_end_to_end import TINY, FakeTokens, make_cfg, make_iterators
+
+
+@pytest.mark.slow
+def test_skip_batches_blacklist(tmp_path):
+    """--skip_batches consumes data but performs no update at those steps
+    (torchrun_main.py:772-775)."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512)
+    cfg = make_cfg(
+        tmp_path, num_training_steps=12, relora=None, use_peft=False,
+        scheduler="cosine", cycle_length=12, skip_batches="3,5", save_every=100,
+    )
+    trainer = Trainer(cfg, model_cfg=TINY)
+    f, _ = make_iterators(cfg, trainer, data)
+    res = trainer.fit(f(), None)
+    assert res["update_step"] == 12
+    # 12 update steps counted, but only 10 device updates happened
+    assert int(trainer.state.step) == 10
+    # metrics.jsonl has no entries for the skipped update steps.  The skip
+    # check uses the pre-increment counter and logs use the post-increment
+    # one (both reference semantics), so skipping {3,5} means logged
+    # update_steps exclude {4,6}.
+    lines = [json.loads(l) for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
+    steps_logged = {l["update_step"] for l in lines if "update_step" in l}
+    assert 4 not in steps_logged and 6 not in steps_logged
+    assert 3 in steps_logged and 5 in steps_logged
+
+
+@pytest.mark.slow
+def test_nan_abort_threshold(tmp_path):
+    """Sustained NaN updates abort the run (torchrun_main.py:820-822)."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512)
+    cfg = make_cfg(
+        tmp_path, num_training_steps=100, relora=None, use_peft=False,
+        scheduler="cosine", cycle_length=100, save_every=1000,
+        nan_abort_fraction=0.02,
+    )
+    trainer = Trainer(cfg, model_cfg=TINY)
+    # poison the params so every loss is NaN
+    trainer.state = trainer.state.replace(
+        params=jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, jnp.nan) if x.dtype == jnp.float32 else x,
+            trainer.state.params,
+        )
+    )
+    f, _ = make_iterators(cfg, trainer, data)
+    res = trainer.fit(f(), None)
+    assert res["aborted"] is True
+    assert res["n_skipped"] > 2  # crossed the 2% threshold then stopped
+    assert res["update_step"] < 100
+
+
+def test_step_profiler_cadence(tmp_path, monkeypatch):
+    from relora_tpu.utils import profiling
+
+    events = []
+    monkeypatch.setattr(
+        profiling.jax.profiler, "start_trace", lambda d: events.append("start")
+    )
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", lambda: events.append("stop"))
+    prof = profiling.StepProfiler(str(tmp_path), wait=1, warmup=1, active=2, repeat=2)
+    for _ in range(12):
+        prof.step()
+    prof.stop()
+    # two complete trace windows, started after wait+warmup each cycle
+    assert events == ["start", "stop", "start", "stop"]
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    from relora_tpu.utils.logging import MetricsLogger
+
+    m = MetricsLogger(run_dir=str(tmp_path))
+    m.log({"loss": jnp.asarray(1.5), "update_step": 3}, step=7)
+    m.alert("test", "message")
+    m.finish()
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert lines[0]["loss"] == 1.5 and lines[0]["_step"] == 7
