@@ -19,12 +19,34 @@ import os
 import time
 
 
+def load_tokenizer(name_or_path: str, eos_token=None):
+    """HF hub id / local dir via AutoTokenizer, or a bare tokenizers-format
+    .json file (works fully offline — parity with the reference's
+    HFTokenizer + vocab_file flow, pile_megatron_dataset.yaml)."""
+    if name_or_path.endswith(".json") and os.path.exists(name_or_path):
+        from transformers import PreTrainedTokenizerFast
+
+        tok = PreTrainedTokenizerFast(
+            tokenizer_file=name_or_path, eos_token=eos_token or "<|endoftext|>"
+        )
+        return tok
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(name_or_path)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--dataset", required=True)
     p.add_argument("--subset", default=None)
     p.add_argument("--split", default="train")
-    p.add_argument("--tokenizer", required=True)
+    p.add_argument(
+        "--tokenizer",
+        required=True,
+        help="HF hub id, local dir, or a tokenizers-format .json file "
+        "(e.g. the reference's configs/pythia_tokenizer.json)",
+    )
+    p.add_argument("--eos_token", default=None, help="EOS string when loading a bare .json tokenizer")
     p.add_argument("--text_field", default="text")
     p.add_argument("--sequence_length", type=int, default=512)
     p.add_argument("--num_proc", type=int, default=8)
@@ -33,7 +55,6 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     import datasets
-    from transformers import AutoTokenizer
 
     from relora_tpu.data.hf_pipeline import tokenize_and_chunk
 
@@ -50,7 +71,7 @@ def main(argv=None):
     else:
         ds = datasets.load_dataset(args.dataset, args.subset, split=args.split)
 
-    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    tokenizer = load_tokenizer(args.tokenizer, args.eos_token)
     out = tokenize_and_chunk(
         ds,
         tokenizer,

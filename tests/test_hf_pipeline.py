@@ -1,0 +1,112 @@
+"""HF data pipeline tests, fully offline: a tiny BPE tokenizer is trained
+in-process, then tokenize_and_chunk / streaming packing / the pretokenize CLI
+are exercised end-to-end (parity surface: dataloader.py + pretokenize.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_tokenizer(tmp_path_factory):
+    """Train a minimal BPE tokenizer locally and save tokenizers-format json."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=200, special_tokens=["<unk>", "<|endoftext|>"]
+    )
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "pack my box with five dozen liquor jugs",
+        "how vexingly quick daft zebras jump",
+    ] * 20
+    tok.train_from_iterator(corpus, trainer)
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(path))
+    return str(path)
+
+
+def load_tok(path):
+    from pretokenize import load_tokenizer
+
+    return load_tokenizer(path)
+
+
+def test_tokenizer_json_loading(tiny_tokenizer):
+    tok = load_tok(tiny_tokenizer)
+    assert tok.eos_token == "<|endoftext|>"
+    assert tok.eos_token_id is not None
+    ids = tok("the quick brown fox", add_special_tokens=False)["input_ids"]
+    assert len(ids) > 0
+
+
+def test_tokenize_and_chunk(tiny_tokenizer):
+    import datasets
+
+    from relora_tpu.data.hf_pipeline import tokenize_and_chunk
+
+    tok = load_tok(tiny_tokenizer)
+    ds = datasets.Dataset.from_list(
+        [{"text": "the quick brown fox jumps over the lazy dog"} for _ in range(50)]
+    )
+    out = tokenize_and_chunk(ds, tok, sequence_length=16, num_proc=1)
+    assert len(out) > 0
+    arr = np.asarray(out[:]["input_ids"])
+    assert arr.shape[1] == 16
+    # every document boundary carries an EOS; chunked stream contains EOS ids
+    assert (arr == tok.eos_token_id).sum() >= len(out) - 1
+
+
+def test_streaming_iterator_matches_offline(tiny_tokenizer):
+    """On-the-fly packing yields the same token stream as pretokenize+chunk
+    (PreprocessedIterableDataset parity, dataloader.py:13-54)."""
+    import datasets
+
+    from relora_tpu.data.hf_pipeline import StreamingTokenIterator, tokenize_and_chunk
+
+    tok = load_tok(tiny_tokenizer)
+    docs = [{"text": f"the quick brown fox number {i} jumps"} for i in range(40)]
+    ds = datasets.Dataset.from_list(docs)
+
+    offline = tokenize_and_chunk(ds, tok, sequence_length=8, num_proc=1)
+    offline_stream = np.asarray(offline[:]["input_ids"]).reshape(-1)
+
+    stream = StreamingTokenIterator(
+        ds, tok, sequence_length=8, microbatch=2, grad_accum=1
+    )
+    got = np.concatenate([b.reshape(-1) for b in stream])
+    n = min(len(got), len(offline_stream))
+    np.testing.assert_array_equal(got[:n], offline_stream[:n])
+
+
+def test_pretokenize_cli_roundtrip(tiny_tokenizer, tmp_path):
+    """The offline prep CLI end-to-end: local dataset dir -> chunked dataset
+    + args.json provenance (pretokenize.py parity incl. the train-time
+    check, torchrun_main.py:452-455)."""
+    import datasets
+
+    import pretokenize
+
+    src = tmp_path / "raw"
+    datasets.Dataset.from_list(
+        [{"text": "pack my box with five dozen liquor jugs"} for _ in range(30)]
+    ).save_to_disk(str(src))
+
+    out = tmp_path / "tok"
+    pretokenize.main(
+        [
+            "--dataset", str(src),
+            "--tokenizer", tiny_tokenizer,
+            "--sequence_length", "16",
+            "--num_proc", "1",
+            "--save_dir", str(out),
+        ]
+    )
+    cooked = datasets.load_from_disk(str(out))
+    assert len(cooked) > 0 and len(cooked[0]["input_ids"]) == 16
+    prov = json.load(open(out / "args.json"))
+    assert prov["sequence_length"] == 16 and prov["n_sequences"] == len(cooked)
