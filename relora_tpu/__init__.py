@@ -23,3 +23,31 @@ here is functional and compiler-first:
 """
 
 __version__ = "0.1.0"
+
+# Lazy top-level API: keeps `import relora_tpu` free of jax/flax import cost
+# (and of XLA backend initialization — multi-host launchers must be able to
+# import this package before jax.distributed.initialize()).
+_API = {
+    "TrainingConfig": "relora_tpu.config.training",
+    "parse_train_args": "relora_tpu.config.training",
+    "ModelConfig": "relora_tpu.config.model",
+    "MODEL_ZOO": "relora_tpu.config.model",
+    "load_model_config": "relora_tpu.config.model",
+    "LoraSpec": "relora_tpu.core.relora",
+    "merge_and_reinit": "relora_tpu.core.relora",
+    "Trainer": "relora_tpu.train.trainer",
+    "LlamaForCausalLM": "relora_tpu.models.llama",
+    "GPTNeoXForCausalLM": "relora_tpu.models.pythia",
+}
+
+
+def __getattr__(name):
+    if name in _API:
+        import importlib
+
+        return getattr(importlib.import_module(_API[name]), name)
+    raise AttributeError(f"module 'relora_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_API))

@@ -60,9 +60,6 @@ def main(argv=None):
         ds = raw[split]
         if limit:
             ds = ds.select(range(min(limit, len(ds))))
-        texts = (
-            list(zip(ds[key1], ds[key2])) if key2 is not None else ds[key1]
-        )
         enc = tokenizer(
             *( [ds[key1], ds[key2]] if key2 else [ds[key1]] ),
             truncation=True,
