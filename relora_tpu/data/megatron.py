@@ -37,6 +37,7 @@ class MegatronDataConfig:
     train_data_paths: Optional[List[str]] = None
     valid_data_paths: Optional[List[str]] = None
     test_data_paths: Optional[List[str]] = None
+    label_data_paths: Optional[List[str]] = None  # aligned with train_data_paths
     train_data_weights: Optional[List[float]] = None
     valid_data_weights: Optional[List[float]] = None
     test_data_weights: Optional[List[float]] = None
@@ -123,6 +124,7 @@ def build_split_datasets(
             weights = weights or [1.0] * len(paths)
             w = np.asarray(weights, dtype=np.float64)
             w = w / w.sum()
+            label_paths = mcfg.label_data_paths if name == "train" else None
             parts = []
             for i, p in enumerate(paths):
                 data = MemmapTokenDataset(p)
@@ -140,6 +142,9 @@ def build_split_datasets(
                         seed=mcfg.seed,
                         is_coordinator=is_coordinator,
                         barrier=barrier,
+                        label_data=(
+                            MemmapTokenDataset(label_paths[i]) if label_paths else None
+                        ),
                     )
                 )
             out.append(parts[0] if len(parts) == 1 else BlendableDataset(parts, w))

@@ -164,9 +164,15 @@ class PackedCausalDataset:
         cache_dir: Optional[str] = None,
         is_coordinator: bool = True,
         barrier=None,
+        label_data: Optional[MemmapTokenDataset] = None,
     ):
         self.name = name
         self.data = data
+        # optional parallel label corpus, token-aligned with ``data``
+        # (parity: label_dataset, dataset.py:96-126 / label_data_paths)
+        self.label_data = label_data
+        if label_data is not None and len(label_data) != len(data):
+            raise ValueError("label corpus must align document-for-document with data")
         self.seq_length = seq_length
         self.doc_idx, self.sample_idx, self.shuffle_idx = build_index_mappings(
             name,
@@ -184,20 +190,28 @@ class PackedCausalDataset:
     def __len__(self) -> int:
         return min(len(self.shuffle_idx), self.sample_idx.shape[0] - 1)
 
+    def _assemble(self, source: MemmapTokenDataset, s: int) -> np.ndarray:
+        pos_f, off_f = int(self.sample_idx[s][0]), int(self.sample_idx[s][1])
+        pos_l, off_l = int(self.sample_idx[s + 1][0]), int(self.sample_idx[s + 1][1])
+        if pos_f == pos_l:
+            tokens = source.get(int(self.doc_idx[pos_f]), offset=off_f, length=off_l - off_f + 1)
+        else:
+            parts = [source.get(int(self.doc_idx[pos_f]), offset=off_f)]
+            for p in range(pos_f + 1, pos_l):
+                parts.append(source.get(int(self.doc_idx[p])))
+            parts.append(source.get(int(self.doc_idx[pos_l]), length=off_l + 1))
+            tokens = np.concatenate(parts)
+        return np.asarray(tokens, dtype=np.int64)
+
     def __getitem__(self, idx) -> dict:
         if isinstance(idx, slice):
             return {"input_ids": np.stack([self[i]["input_ids"] for i in range(*idx.indices(len(self)))])}
         if idx >= len(self):
             idx = idx % len(self)  # parity: modulo wrap (dataset.py:78-86)
         s = int(self.shuffle_idx[idx])
-        pos_f, off_f = int(self.sample_idx[s][0]), int(self.sample_idx[s][1])
-        pos_l, off_l = int(self.sample_idx[s + 1][0]), int(self.sample_idx[s + 1][1])
-        if pos_f == pos_l:
-            tokens = self.data.get(int(self.doc_idx[pos_f]), offset=off_f, length=off_l - off_f + 1)
-        else:
-            parts = [self.data.get(int(self.doc_idx[pos_f]), offset=off_f)]
-            for p in range(pos_f + 1, pos_l):
-                parts.append(self.data.get(int(self.doc_idx[p])))
-            parts.append(self.data.get(int(self.doc_idx[pos_l]), length=off_l + 1))
-            tokens = np.concatenate(parts)
-        return {"input_ids": np.asarray(tokens, dtype=np.int64)}
+        out = {"input_ids": self._assemble(self.data, s)}
+        if self.label_data is not None:
+            # labels assembled with the same index maps — fully in sync
+            # (parity: dataset.py:96-126)
+            out["label"] = self._assemble(self.label_data, s)
+        return out

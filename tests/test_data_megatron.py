@@ -286,3 +286,30 @@ def test_reference_production_yaml_loads():
     mcfg = MegatronDataConfig.from_yaml("/root/reference/configs/pile_megatron_dataset.yaml")
     assert mcfg.seq_length == 2048 and mcfg.data_impl == "mmap"
     assert mcfg.train_data_paths == ["/fsx/pile/pile_20B_tokenizer_text_document"]
+
+
+def test_label_dataset_alignment(tmp_path):
+    """Parallel label corpus assembled with the same index maps
+    (parity: label_dataset, dataset.py:96-126)."""
+    prefix, docs = write_corpus(tmp_path / "d", n_docs=40, seed=3)
+    # label corpus: same doc lengths, tokens shifted by +1 mod vocab
+    lp = str(tmp_path / "l" / "labels")
+    with MemmapTokenWriter(lp, dtype=np.uint16) as w:
+        for d in docs:
+            w.add_document((d + 1) % 1000)
+    data = MemmapTokenDataset(prefix)
+    labels = MemmapTokenDataset(lp)
+    ds = PackedCausalDataset(
+        name="t", data=data, documents=np.arange(40), num_samples=20,
+        seq_length=16, seed=0, label_data=labels,
+    )
+    for i in range(20):
+        s = ds[i]
+        assert s["label"].shape == s["input_ids"].shape
+        np.testing.assert_array_equal(s["label"], (s["input_ids"] + 1) % 1000)
+    short_prefix, _ = write_corpus(tmp_path / "short", n_docs=3, seed=9)
+    with pytest.raises(ValueError, match="align"):
+        PackedCausalDataset(
+            name="t2", data=data, documents=np.arange(40), num_samples=5,
+            seq_length=16, seed=0, label_data=MemmapTokenDataset(short_prefix),
+        )
