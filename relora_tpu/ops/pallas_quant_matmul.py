@@ -1,0 +1,81 @@
+"""Pallas TPU kernel: matmul against an int8 frozen base, dequantizing
+inside the tile loop.
+
+The point of int8 base storage (ops/quant.py) is HBM: with a plain
+``dequantize → matmul``, XLA may materialize the dequantized kernel, moving
+f32/bf16 bytes through HBM anyway.  This kernel keeps the weight int8 all the
+way into VMEM and dequantizes per tile right before the MXU dot — the weight
+side of the matmul reads 1 byte/element from HBM, a 4× traffic cut vs f32.
+
+Layout: ``y[M, N] = x[M, K] @ (q[K, N] · scale[1, N])`` with f32
+accumulation.  Grid is (M/bm, N/bn); each program reads an (bm, K) activation
+stripe and a (K, bn) int8 weight stripe.  Block sizes respect the v5e tiling
+constraints (last dim 128, second-to-last a multiple of 8).
+
+``interpret=True`` runs the same kernel on CPU for differential testing; the
+TPU path is opt-in (RELORA_TPU_PALLAS_QUANT=1) until validated per-chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _dequant_matmul_kernel(x_ref, q_ref, scale_ref, out_ref):
+    x = x_ref[:]
+    w = q_ref[:].astype(jnp.float32) * scale_ref[:]  # dequant in VMEM
+    out_ref[:] = jax.lax.dot_general(
+        x.astype(jnp.float32),
+        w,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret", "out_dtype"))
+def dequant_matmul(
+    x: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+    out_dtype=None,
+) -> jax.Array:
+    """``x @ (q * scale)`` with the dequant fused into the kernel.
+
+    ``x``: (..., M, K) activations; ``q``: (K, N) int8; ``scale``: (1, N) f32.
+    M and N must tile by block_m/block_n (pad upstream if not).
+    """
+    out_dtype = out_dtype or x.dtype
+    lead = x.shape[:-2] if x.ndim > 2 else ()
+    x2 = x.reshape(-1, x.shape[-1]) if x.ndim != 2 else x
+    M, K = x2.shape
+    Kq, N = q.shape
+    if K != Kq:
+        raise ValueError(f"contraction mismatch: x K={K} vs q K={Kq}")
+    bm = min(block_m, M)
+    bn = min(block_n, N)
+    if M % bm or N % bn:
+        raise ValueError(f"M={M}, N={N} must tile by ({bm}, {bn})")
+
+    out = pl.pallas_call(
+        _dequant_matmul_kernel,
+        grid=(M // bm, N // bn),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(x2, q, scale)
+    if x.ndim != 2:
+        out = out.reshape(*lead, x.shape[-2], N)
+    return out

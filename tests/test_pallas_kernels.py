@@ -1,0 +1,40 @@
+"""Custom pallas kernel tests (interpret mode on CPU; the TPU path shares
+the exact same kernel body)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.ops.pallas_quant_matmul import dequant_matmul
+from relora_tpu.ops.quant import dequantize_int8, quantize_int8
+
+
+def test_dequant_matmul_matches_reference():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (256, 192))
+    w = jax.random.normal(jax.random.fold_in(key, 1), (192, 256)) * 0.1
+    q, s = quantize_int8(w)
+    want = x @ dequantize_int8(q, s)
+    got = dequant_matmul(x, q, s, block_m=128, block_n=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_dequant_matmul_batched_and_blocks():
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (2, 4, 128, 64))  # leading batch dims
+    w = jax.random.normal(jax.random.fold_in(key, 1), (64, 128)) * 0.05
+    q, s = quantize_int8(w)
+    want = jnp.einsum("...mk,kn->...mn", x, dequantize_int8(q, s))
+    got = dequant_matmul(x, q, s, block_m=256, block_n=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_dequant_matmul_validation():
+    x = jnp.zeros((100, 64))
+    q = jnp.zeros((64, 128), jnp.int8)
+    s = jnp.ones((1, 128))
+    with pytest.raises(ValueError, match="tile"):
+        dequant_matmul(x, q, s, block_m=64, block_n=128, interpret=True)
+    with pytest.raises(ValueError, match="mismatch"):
+        dequant_matmul(jnp.zeros((128, 32)), q, s, interpret=True)
