@@ -103,8 +103,13 @@ def restore_params_host(path: str) -> PyTree:
     import orbax.checkpoint as ocp
 
     state_path = os.path.abspath(os.path.join(path, STATE_SUBDIR))
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"no checkpoint state at {state_path}")
     ckptr = ocp.PyTreeCheckpointer()
-    tree = ckptr.metadata(state_path).item_metadata.tree
+    item_metadata = ckptr.metadata(state_path).item_metadata
+    if item_metadata is None:
+        raise FileNotFoundError(f"checkpoint at {state_path} has no readable metadata")
+    tree = item_metadata.tree
     restore_args = jax.tree_util.tree_map(
         lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
     )

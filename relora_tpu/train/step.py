@@ -38,6 +38,7 @@ def make_train_step(
     *,
     clip_grad_norm: float = 1.0,
     schedule: Optional[Callable] = None,
+    grad_breakdown: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -119,6 +120,24 @@ def make_train_step(
         }
         if schedule is not None:
             metrics["lr"] = schedule(state.step)
+        if grad_breakdown:
+            # per-top-level-subtree grad norms (the observability wandb.watch
+            # provided in the reference, torchrun_main.py:624-627)
+            from relora_tpu.core.optim import global_norm
+
+            for key, sub in grads.items():
+                metrics[f"grad_norm/{key}"] = global_norm(sub)
+        # per-run mean of trainable scalings (parity: per-layer lora_scaling
+        # logging under --train_scaling, torchrun_main.py:937-942)
+        scaling_leaves = [
+            leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(final_trainable)[0]
+            if str(getattr(path[-1], "key", path[-1])) == "lora_s"
+        ]
+        if scaling_leaves:
+            metrics["lora_scaling"] = jnp.tanh(
+                jnp.mean(jnp.stack([l.mean() for l in scaling_leaves]))
+            )
         return new_state, metrics
 
     return train_step

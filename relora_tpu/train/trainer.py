@@ -288,6 +288,7 @@ class Trainer:
                 self.trainable_mask,
                 clip_grad_norm=cfg.clip_grad_norm,
                 schedule=lambda s: self.schedule(s - start),
+                grad_breakdown=cfg.wandb_watch,
             ),
             donate_argnums=0,
         )
@@ -450,21 +451,23 @@ class Trainer:
                 if int(metrics["n_skipped"]) > cfg.nan_abort_fraction * cfg.num_training_steps:
                     logger.error("More than 5% of updates NaN-skipped; aborting")
                     return False
-            self.metrics.log(
-                {
-                    "loss": float(metrics["loss"]),
-                    "lr": float(metrics.get("lr", 0.0)),
-                    "update_step": at_step,
-                    "tokens_seen": self.tokens_seen,
-                    "grad_norm": float(metrics["grad_norm"]),
-                    "throughput_tokens": tokens_in_update / dt,
-                    "throughput_examples": cfg.total_batch_size / dt,
-                    "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
-                    "n_lora_restarts": self.n_lora_restarts,
-                    "n_optimizer_resets": self.n_optimizer_resets,
-                },
-                step=at_global,
-            )
+            record = {
+                "loss": float(metrics["loss"]),
+                "lr": float(metrics.get("lr", 0.0)),
+                "update_step": at_step,
+                "tokens_seen": self.tokens_seen,
+                "grad_norm": float(metrics["grad_norm"]),
+                "throughput_tokens": tokens_in_update / dt,
+                "throughput_examples": cfg.total_batch_size / dt,
+                "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
+                "n_lora_restarts": self.n_lora_restarts,
+                "n_optimizer_resets": self.n_optimizer_resets,
+            }
+            # extra device metrics (grad_norm/* breakdown, lora_scaling, ...)
+            for k, v in metrics.items():
+                if k not in record and k not in ("skipped", "n_skipped"):
+                    record[k] = float(v)
+            self.metrics.log(record, step=at_global)
             if prof is not None:
                 prof.step()
             return True

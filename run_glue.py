@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 
 
 def main(argv=None):
@@ -29,6 +30,14 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max_train_samples", type=int, default=None)
     args = p.parse_args(argv)
+
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
     import datasets
     import numpy as np

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Smoke-test battery (systematizes the reference's README.dev.md command
+# list): tiny configs covering the common training regimes, runnable on CPU
+# in a few minutes.  Exercises the real CLIs end-to-end.
+#
+#   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+#       bash scripts/smoke_test.sh [workdir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK="${1:-$(mktemp -d /tmp/relora_smoke.XXXX)}"
+echo "workdir: $WORK"
+
+python - "$WORK" <<'EOF'
+import sys, numpy as np
+from relora_tpu.data.memmap import MemmapTokenWriter, best_dtype
+rs = np.random.RandomState(0)
+with MemmapTokenWriter(f"{sys.argv[1]}/corpus", dtype=best_dtype(128)) as w:
+    for _ in range(3000):
+        start = rs.randint(128); n = rs.randint(10, 80)
+        w.add_document([(start + j) % 128 for j in range(n)])
+print("corpus written")
+EOF
+
+cat > "$WORK/mega.yaml" <<EOF
+data_path: $WORK/corpus
+split: "8,1,1"
+seq_length: 32
+seed: 0
+data_impl: mmap
+EOF
+
+common=(--megatron_dataset_config "$WORK/mega.yaml" --model_config llama_9m
+        --batch_size 4 --total_batch_size 8 --max_length 32 --dp_size 2
+        --warmup_steps 2 --eval_every 1000 --seed 0)
+
+echo "=== 1. full-rank ==="
+python main.py "${common[@]}" --lr 3e-3 --scheduler cosine --cycle_length 8 \
+    --num_training_steps 8 --save_every 8 --save_dir "$WORK/full"
+
+echo "=== 2. ReLoRA from warm start ==="
+python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --warmed_up_model "$WORK/full/model_8" \
+    --num_training_steps 32 --save_every 8 --save_dir "$WORK/relora"
+
+echo "=== 3. ReLoRA + magnitude pruning + int8 base ==="
+python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --reset_optimizer_on_relora false --optimizer_magnitude_pruning 0.8 \
+    --quantize int8 --warmed_up_model "$WORK/full/model_8" \
+    --num_training_steps 24 --save_every 100 --save_dir "$WORK/relora_q"
+
+echo "=== 4. autoresume continues run 2 ==="
+python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --num_training_steps 40 --save_every 8 --save_dir "$WORK/relora" \
+    --autoresume true
+
+echo "=== 5. analysis tools ==="
+python tools/analyze_rank.py --before "$WORK/relora/model_16" --after "$WORK/relora/model_40" | head -4
+python tools/inspect_optimizer.py "$WORK/relora/model_40" | head -3
+
+echo "SMOKE OK"

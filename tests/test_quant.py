@@ -94,3 +94,41 @@ def test_quantized_merge_dequant_add_requant():
     assert rel < 0.01
     assert float(jnp.abs(out["m"]["lora_b"]).max()) == 0.0
     assert out["m"]["kernel_q"].dtype == jnp.int8
+
+
+@pytest.mark.slow
+def test_quantized_end_to_end_training(tmp_path):
+    """Trainer with quantize=int8: full-rank warmup -> int8-base ReLoRA run
+    (merges requantize), loss finite, codes stay int8."""
+    from tests.test_end_to_end import FakeTokens, make_cfg, make_iterators
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512, vocab=64)
+    tiny = TINY
+    cfg_full = make_cfg(
+        tmp_path / "full", use_peft=False, relora=None, scheduler="cosine",
+        cycle_length=8, num_training_steps=8, save_every=8,
+    )
+    tr_full = Trainer(cfg_full, model_cfg=tiny)
+    f, _ = make_iterators(cfg_full, tr_full, data)
+    tr_full.fit(f(), None)
+
+    cfg_q = make_cfg(
+        tmp_path / "q",
+        warmed_up_model=str(tmp_path / "full" / "ckpt" / "model_8"),
+        num_training_steps=24, relora=8, cycle_length=8, quantize="int8",
+        save_every=100,
+    )
+    tr_q = Trainer(cfg_q, model_cfg=tiny)
+    q_mod = tr_q.state.params["layers"]["self_attn"]["q_proj"]
+    assert q_mod["kernel_q"].dtype == jnp.int8
+    # warm start actually quantized the full-rank weights (not zeros)
+    assert int(jnp.abs(q_mod["kernel_q"]).max()) > 0
+    fq, eq = make_iterators(cfg_q, tr_q, data)
+    res = tr_q.fit(fq(), eq)
+    # warm start at step 8: triggers fire at 9/17/25, but the can_reset gate
+    # (local_updates >= relora, torchrun_main.py:874-877) blocks step 9 —
+    # exactly one merge lands inside the 16-step run
+    assert res["update_step"] == 24 and tr_q.n_lora_restarts == 1
+    assert np.isfinite(res["final_eval_loss"])
+    assert tr_q.state.params["layers"]["self_attn"]["q_proj"]["kernel_q"].dtype == jnp.int8
