@@ -30,21 +30,10 @@ import os
 import sys
 
 
-def _honor_platform_request() -> None:
-    """Make JAX_PLATFORMS=cpu work even where a site plugin force-selects a
-    TPU backend via jax.config at import (see tests/conftest.py note)."""
-    want = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
-    if want == "cpu":
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-
-
 def main(argv=None) -> dict:
-    _honor_platform_request()
+    from relora_tpu.utils.logging import honor_platform_request
+
+    honor_platform_request()
     from relora_tpu.config.training import parse_train_args
     from relora_tpu.utils.logging import get_logger
 

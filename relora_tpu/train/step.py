@@ -135,8 +135,10 @@ def make_train_step(
             if str(getattr(path[-1], "key", path[-1])) == "lora_s"
         ]
         if scaling_leaves:
-            metrics["lora_scaling"] = jnp.tanh(
-                jnp.mean(jnp.stack([l.mean() for l in scaling_leaves]))
+            # mean of the *effective* scales (tanh applied per leaf, exactly
+            # as the forward pass uses them)
+            metrics["lora_scaling"] = jnp.mean(
+                jnp.stack([jnp.tanh(l.astype(jnp.float32)).mean() for l in scaling_leaves])
             )
         return new_state, metrics
 

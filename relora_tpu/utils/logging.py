@@ -156,3 +156,16 @@ def _to_scalar(v: Any) -> Any:
 
 def metrics_logger(**kwargs) -> MetricsLogger:
     return MetricsLogger(**kwargs)
+
+
+def honor_platform_request() -> None:
+    """Make JAX_PLATFORMS=cpu effective even where a site plugin force-selects
+    a TPU backend via jax.config at import time (the env var alone is
+    overridden in such sandboxes).  Call before the first jax computation."""
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass

@@ -31,13 +31,9 @@ def main(argv=None):
     p.add_argument("--max_train_samples", type=int, default=None)
     args = p.parse_args(argv)
 
-    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
-        import jax
+    from relora_tpu.utils.logging import honor_platform_request
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    honor_platform_request()
 
     import datasets
     import numpy as np
