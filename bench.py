@@ -18,10 +18,33 @@ FLOPs, so remat recompute deflates it.)
 from __future__ import annotations
 
 import json
+import os
+import signal
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+# Watchdog: if the TPU tunnel wedges (observed in this sandbox), emit a
+# diagnostic line instead of hanging forever.
+WATCHDOG_SECS = int(os.environ.get("BENCH_WATCHDOG_SECS", "900"))
+
+
+def _watchdog(signum, frame):
+    print(
+        json.dumps(
+            {
+                "metric": "bench watchdog",
+                "value": 0,
+                "unit": "tokens/sec/chip",
+                "vs_baseline": 0,
+                "detail": {"error": f"no result within {WATCHDOG_SECS}s (TPU tunnel stalled?)"},
+            }
+        )
+    )
+    sys.stdout.flush()
+    os._exit(2)
 
 MODEL = "llama_1b"
 MICRO_BATCH = 8
@@ -104,4 +127,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    signal.signal(signal.SIGALRM, _watchdog)
+    signal.alarm(WATCHDOG_SECS)
     main()
+    signal.alarm(0)

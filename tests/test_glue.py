@@ -113,3 +113,28 @@ def test_finetune_learns_synthetic_task():
         pretrained_backbone=lm_params,
     )
     assert metrics["accuracy"] > 0.9
+
+
+@pytest.mark.slow
+def test_finetune_with_lora():
+    """GLUE fine-tuning with LoRA adapters on the classifier backbone."""
+    rs = np.random.RandomState(1)
+
+    def make(n):
+        ids = rs.randint(2, 64, size=(n, 10)).astype(np.int32)
+        labels = rs.randint(0, 2, size=n)
+        ids[:, 0] = np.where(labels == 1, 1, 2)
+        return ids, labels
+
+    train_ids, train_labels = make(128)
+    bs = 32
+    steps = len(train_ids) // bs
+
+    def batches():
+        for i in range(steps):
+            yield train_ids[i * bs:(i + 1) * bs], train_labels[i * bs:(i + 1) * bs]
+
+    gcfg = GlueConfig(task="sst2", lr=8e-3, batch_size=bs, num_epochs=4,
+                      use_lora=True, lora_r=4, seed=1)
+    metrics = finetune(TINY, gcfg, batches, batches, steps, pad_token_id=0)
+    assert metrics["accuracy"] > 0.8

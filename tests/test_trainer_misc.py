@@ -90,3 +90,26 @@ def test_metrics_logger_jsonl(tmp_path):
     m.finish()
     lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
     assert lines[0]["loss"] == 1.5 and lines[0]["_step"] == 7
+
+
+@pytest.mark.slow
+def test_trainable_scaling_end_to_end(tmp_path):
+    """--train_scaling: lora_s leaves exist, train, get logged as mean
+    effective (tanh) scale, and reset to zero on merge."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512)
+    cfg = make_cfg(tmp_path, train_scaling=True, num_training_steps=16,
+                   relora=8, cycle_length=8, save_every=100)
+    trainer = Trainer(cfg, model_cfg=TINY)
+    assert "lora_s" in trainer.state.params["layers"]["self_attn"]["q_proj"]
+    f, _ = make_iterators(cfg, trainer, data)
+    res = trainer.fit(f(), None)
+    assert res["update_step"] == 16 and trainer.n_lora_restarts == 1
+    lines = [json.loads(l) for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
+    scal = [l["lora_scaling"] for l in lines if "lora_scaling" in l]
+    assert scal and all(-1.0 <= s <= 1.0 for s in scal)
+    # merge at step 9 zeroed the scalings
+    s_leaf = np.asarray(trainer.state.params["layers"]["self_attn"]["q_proj"]["lora_s"])
+    # one step of training after the merge may have nudged it slightly
+    assert np.abs(s_leaf).max() < 0.1
