@@ -166,17 +166,33 @@ MODEL_ZOO: dict[str, ModelConfig] = {
 }
 
 
+# HF hub ids used by reference recipes -> zoo entries, so configs like
+# "model_name_or_path: EleutherAI/pythia-1b" (training_configs/1B_v1.0.yaml)
+# resolve without network access.  Weights still come from a local snapshot
+# via --warmed_up_model.
+HF_ID_ALIASES = {
+    f"EleutherAI/pythia-{size}": f"pythia_{size.replace('-deduped', '')}"
+    for size in ("70m", "160m", "410m", "1b", "1.4b")
+} | {
+    f"EleutherAI/pythia-{size}-deduped": f"pythia_{size}"
+    for size in ("70m", "160m", "410m", "1b", "1.4b")
+}
+
+
 def load_model_config(name_or_path: str) -> ModelConfig:
-    """Resolve a zoo name ("llama_35m"), an HF-style JSON path, or a dir with config.json."""
+    """Resolve a zoo name ("llama_35m"), a known HF hub id, an HF-style JSON
+    path, or a dir with config.json."""
     import os
 
     if name_or_path in MODEL_ZOO:
         return MODEL_ZOO[name_or_path]
+    if name_or_path in HF_ID_ALIASES:
+        return MODEL_ZOO[HF_ID_ALIASES[name_or_path]]
     if os.path.isdir(name_or_path):
         name_or_path = os.path.join(name_or_path, "config.json")
     if os.path.exists(name_or_path):
         return ModelConfig.from_hf_json(name_or_path)
     raise ValueError(
         f"Unknown model config {name_or_path!r}: not in MODEL_ZOO "
-        f"({sorted(MODEL_ZOO)}) and not a file"
+        f"({sorted(MODEL_ZOO)}), not a known HF id, and not a file"
     )
