@@ -112,6 +112,7 @@ class TrainingConfig:
     tp_size: int = 1  # tensor axis
     sp_size: int = 1  # sequence (ring attention / context parallel) axis
     remat: bool = False  # gradient checkpointing on decoder layers
+    bf16_logits: bool = False  # halve the logits HBM footprint; CE still f32
     # opt-in pallas flash kernel: XLA's fused attention is the robust default
     # (and the sandbox's remote-compile tunnel stalls on the pallas kernel)
     flash_attention: bool = False

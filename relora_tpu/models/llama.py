@@ -262,6 +262,9 @@ class LlamaForCausalLM(nn.Module):
     scan_layers: bool = True
     remat: bool = False
     attention_impl: str = "auto"
+    # f32 logits are the safe default; bf16 halves the (B, S, vocab) HBM
+    # footprint — the loss upcasts to f32 either way
+    logits_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(
@@ -279,7 +282,7 @@ class LlamaForCausalLM(nn.Module):
             kernel_axes=("embed", "vocab"),
             name="lm_head",
         )(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(self.logits_dtype)
 
 
 class LlamaBackbone(nn.Module):

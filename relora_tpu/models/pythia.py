@@ -155,6 +155,7 @@ class GPTNeoXForCausalLM(nn.Module):
     scan_layers: bool = True
     remat: bool = False
     attention_impl: str = "auto"
+    logits_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(
@@ -215,4 +216,4 @@ class GPTNeoXForCausalLM(nn.Module):
             kernel_axes=("embed", "vocab"),
             name="embed_out",
         )(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(self.logits_dtype)

@@ -24,6 +24,8 @@ def causal_lm_loss(
     Returns ``(mean_loss, n_tokens)`` where n_tokens is the count the mean ran
     over (needed by distributed eval aggregation, torchrun_main.py:159-183).
     """
+    # upcast per-position inside log_softmax; accepts bf16 logits (the
+    # bf16_logits option) without a separate f32 materialization
     shift_logits = logits[:, :-1, :].astype(jnp.float32)
     shift_labels = input_ids[:, 1:]
     logp = jax.nn.log_softmax(shift_logits, axis=-1)

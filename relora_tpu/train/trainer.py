@@ -79,6 +79,7 @@ def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingC
         scan_layers=True,
         remat=cfg.remat,
         attention_impl=attention_impl,
+        logits_dtype=jnp.bfloat16 if cfg.bf16_logits else jnp.float32,
     )
     if model_cfg.family == "llama":
         return LlamaForCausalLM(**kwargs)

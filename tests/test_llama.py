@@ -228,3 +228,19 @@ def test_lora_only_mode():
         np.asarray(merged["layers"]["self_attn"]["q_proj"]["lora_a"]),
         np.asarray(q["lora_a"]),
     )
+
+
+def test_bf16_logits_option():
+    """bf16 logits: same predictions, loss within bf16 tolerance of f32."""
+    model_f32, params = init_model()
+    model_bf16 = LlamaForCausalLM(TINY, dtype=jnp.float32, logits_dtype=jnp.bfloat16)
+    ids = jax.random.randint(jax.random.PRNGKey(9), (2, 16), 0, 256)
+    lf = model_f32.apply({"params": params}, ids)
+    lb = model_bf16.apply({"params": params}, ids)
+    assert lb.dtype == jnp.bfloat16
+    loss_f = float(causal_lm_loss(lf, ids)[0])
+    loss_b = float(causal_lm_loss(lb, ids)[0])
+    assert loss_b == pytest.approx(loss_f, rel=2e-2)
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(lf), -1), np.argmax(np.asarray(lb.astype(jnp.float32)), -1)
+    )
