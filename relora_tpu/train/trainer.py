@@ -442,7 +442,7 @@ class Trainer:
             nonlocal pending
             if pending is None:
                 return True
-            metrics, at_step, at_global, tokens_in_update, dt = pending
+            metrics, at_step, at_global, tokens_in_update, dt, counters = pending
             pending = None
             if float(metrics["skipped"]):
                 logger.error(
@@ -461,8 +461,9 @@ class Trainer:
                 "throughput_tokens": tokens_in_update / dt,
                 "throughput_examples": cfg.total_batch_size / dt,
                 "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
-                "n_lora_restarts": self.n_lora_restarts,
-                "n_optimizer_resets": self.n_optimizer_resets,
+                # snapshotted when the record was created, so counts attribute
+                # to the update they happened at despite the one-step lag
+                **counters,
             }
             # extra device metrics (grad_norm/* breakdown, lora_scaling, ...)
             for k, v in metrics.items():
@@ -568,7 +569,17 @@ class Trainer:
             update_start = time.time()
             tokens_in_update = self.tokens_seen - self.tokens_seen_before
             self.tokens_seen_before = self.tokens_seen
-            pending = (metrics, self.update_step, self.global_step, tokens_in_update, update_time)
+            pending = (
+                metrics,
+                self.update_step,
+                self.global_step,
+                tokens_in_update,
+                update_time,
+                {
+                    "n_lora_restarts": self.n_lora_restarts,
+                    "n_optimizer_resets": self.n_optimizer_resets,
+                },
+            )
         if not flush_pending():
             aborted = True
         if prof is not None:

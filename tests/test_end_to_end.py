@@ -251,3 +251,38 @@ def test_relora_quality_tracks_full_rank(tmp_path):
     # tracks full-rank
     assert full_loss < 4.0 and relora_loss < 4.0
     assert relora_loss < full_loss * 1.35
+
+
+@pytest.mark.slow
+def test_reset_schedule_phase_alignment(tmp_path):
+    """Step-trace golden test for the reset/scheduler coupling (SURVEY.md §7
+    'hard parts'): merges fire at cycle step 1, and the logged LR follows the
+    cosine_restarts re-warmup exactly at those steps."""
+    from relora_tpu.core.schedules import make_schedule
+    from relora_tpu.train.trainer import Trainer
+
+    cfg = make_cfg(tmp_path, num_training_steps=24, relora=8, cycle_length=8,
+                   warmup_steps=2, restart_warmup_steps=2, save_every=100)
+    data = FakeTokens(n=1024)
+    trainer = Trainer(cfg, model_cfg=TINY)
+    f, _ = make_iterators(cfg, trainer, data)
+    trainer.fit(f(), None)
+
+    lines = [json.loads(l) for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
+    lr_by_step = {l["update_step"]: l["lr"] for l in lines if "lr" in l}
+    restarts_by_step = {l["update_step"]: l["n_lora_restarts"] for l in lines if "n_lora_restarts" in l}
+
+    sched = make_schedule("cosine_restarts", lr=cfg.lr, num_training_steps=24,
+                          warmup_steps=2, min_lr_ratio=cfg.min_lr_ratio,
+                          cycle_length=8, restart_warmup_steps=2)
+    # logged LR at update u is the schedule at step u-1 (lr applied BY that update)
+    for u, lr in lr_by_step.items():
+        assert lr == pytest.approx(float(sched(u - 1)), rel=1e-5), f"step {u}"
+    # LR drops to ~0 exactly at the cycle boundaries (steps 8 and 16 applied
+    # schedule(8)=0 at update 9's log? schedule(8)=restart boundary -> 0)
+    assert lr_by_step[9] == pytest.approx(float(sched(8)), abs=1e-9)
+    assert float(sched(8)) == 0.0 and float(sched(16)) == 0.0
+    # merges recorded at updates 9 and 17 (cycle step 1), in the same log
+    # record where the rewarmup begins
+    assert restarts_by_step[8] == 0 and restarts_by_step[9] == 1
+    assert restarts_by_step[16] == 1 and restarts_by_step[17] == 2
