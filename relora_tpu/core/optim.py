@@ -27,7 +27,7 @@ torchrun_main.py:905-912); embeddings/norms/lm_head keep their moments.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,11 +37,6 @@ from relora_tpu.core.relora import is_lora_path
 from relora_tpu.core.schedules import Schedule
 
 PyTree = Any
-
-
-class OptimizerBundle(NamedTuple):
-    tx: optax.GradientTransformation
-    schedule: Schedule
 
 
 def build_optimizer(

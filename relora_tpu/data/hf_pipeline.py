@@ -18,7 +18,7 @@ Capability parity with peft_pretraining/dataloader.py:
 from __future__ import annotations
 
 import itertools
-from typing import Any, Iterator, Optional
+from typing import Iterator, Optional
 
 import numpy as np
 

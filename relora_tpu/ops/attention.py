@@ -18,7 +18,6 @@ All take/return ``(batch, seq, heads, head_dim)``.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -93,12 +93,12 @@ def restore_checkpoint(path: str, abstract_state: PyTree) -> PyTree:
     return ckptr.restore(os.path.abspath(os.path.join(path, STATE_SUBDIR)), abstract_state)
 
 
-def restore_params_host(path: str) -> PyTree:
-    """Template-free restore of just the saved params subtree, as host numpy
-    arrays.  Used for warm starts and offline tools, where the saved tree
-    (e.g. full-rank, its own optimizer) deliberately differs from the new
-    run's state shape — and possibly from the current device topology, so
-    every leaf is forced to numpy instead of the recorded shardings."""
+def restore_state_host(path: str) -> PyTree:
+    """Template-free restore of the full saved state as host numpy arrays.
+
+    Works regardless of the current device topology (every leaf is forced to
+    numpy instead of the recorded shardings) — for warm starts and offline
+    tools."""
     import numpy as np
     import orbax.checkpoint as ocp
 
@@ -109,11 +109,17 @@ def restore_params_host(path: str) -> PyTree:
     item_metadata = ckptr.metadata(state_path).item_metadata
     if item_metadata is None:
         raise FileNotFoundError(f"checkpoint at {state_path} has no readable metadata")
-    tree = item_metadata.tree
     restore_args = jax.tree_util.tree_map(
-        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item_metadata.tree
     )
-    restored = ckptr.restore(state_path, restore_args=restore_args)
+    return ckptr.restore(state_path, restore_args=restore_args)
+
+
+def restore_params_host(path: str) -> PyTree:
+    """Just the params subtree of ``restore_state_host`` (the saved tree —
+    e.g. full-rank with its own optimizer — may deliberately differ from the
+    new run's state shape)."""
+    restored = restore_state_host(path)
     if isinstance(restored, Mapping) and "params" in restored:
         return restored["params"]
     return restored

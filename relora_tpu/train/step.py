@@ -16,7 +16,6 @@ shardings make XLA insert the DDP/FSDP collectives.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax

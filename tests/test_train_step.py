@@ -67,8 +67,6 @@ def test_loss_decreases_lora_only_trainables_move():
     model, state, step = build(lora=spec)
     step = jax.jit(step, donate_argnums=0)
     batch = jax.random.randint(jax.random.PRNGKey(1), (1, 4, 16), 0, 128)
-    import copy
-
     frozen_kernel_before = np.asarray(
         state.params["layers"]["self_attn"]["q_proj"]["kernel"]
     ).copy()

@@ -29,18 +29,9 @@ def main(argv=None):
     # offline tool: host CPU is all we need, and restoring through a TPU
     # tunnel backend can stall
     jax.config.update("jax_platforms", "cpu")
-    import orbax.checkpoint as ocp
-    import os
+    from relora_tpu.train.checkpoint import restore_state_host
 
-    from relora_tpu.train.checkpoint import STATE_SUBDIR
-
-    state_path = os.path.abspath(os.path.join(args.checkpoint, STATE_SUBDIR))
-    ckptr = ocp.PyTreeCheckpointer()
-    tree = ckptr.metadata(state_path).item_metadata.tree
-    restore_args = __import__("jax").tree_util.tree_map(
-        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
-    )
-    state = ckptr.restore(state_path, restore_args=restore_args)
+    state = restore_state_host(args.checkpoint)
 
     opt_state = state["opt_state"]
 
