@@ -313,3 +313,23 @@ def test_label_dataset_alignment(tmp_path):
             name="t2", data=data, documents=np.arange(40), num_samples=5,
             seq_length=16, seed=0, label_data=MemmapTokenDataset(short_prefix),
         )
+
+
+def test_data_order_invariant_to_host_count(tmp_path):
+    """SURVEY 'hard part': deterministic resumable data order across host
+    counts — the global update batch is identical whether read by 1 host or
+    sliced by 2 (contiguous slicing)."""
+    prefix, _ = write_corpus(tmp_path, n_docs=80)
+    mcfg = MegatronDataConfig(data_path=prefix, split="10,0,0", seq_length=16, seed=0)
+    train, _, _ = build_split_datasets(mcfg, (32, 0, 0))
+
+    single = list(PackedBatchIterator(train, microbatch=4, grad_accum=2))
+    h0 = list(PackedBatchIterator(train, microbatch=2, grad_accum=2,
+                                  process_index=0, process_count=2))
+    h1 = list(PackedBatchIterator(train, microbatch=2, grad_accum=2,
+                                  process_index=1, process_count=2))
+    assert len(single) == len(h0) == len(h1)
+    for s, a, b in zip(single, h0, h1):
+        # global batch rows = concat of per-host rows, in order
+        combined = np.concatenate([a.reshape(-1, 17), b.reshape(-1, 17)])
+        np.testing.assert_array_equal(s.reshape(-1, 17), combined)

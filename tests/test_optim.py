@@ -182,3 +182,38 @@ def test_path_hash_deterministic():
     import zlib
 
     assert _path_hash(("a", "b")) == zlib.crc32(b"a/b")
+
+
+@pytest.mark.usefixtures("devices")
+def test_magnitude_reset_on_sharded_state_matches_unsharded():
+    """SURVEY 'hard part': torch.quantile on a full tensor must become a
+    correct global quantile when the optimizer state is sharded.  jnp.quantile
+    under GSPMD computes globally — verify sharded == unsharded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from relora_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    params = make_trainable_tree()
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    _, state = run_steps(tx, params)
+
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    shard = NamedSharding(mesh, P("fsdp"))
+
+    def shard_leaf(x):
+        if x.ndim >= 1 and x.shape[0] % 8 == 0:
+            return jax.device_put(x, shard)
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    sharded_state = jax.tree_util.tree_map(shard_leaf, state)
+    with mesh:
+        out_sharded = jax.jit(
+            lambda s: reset_optimizer_state(s, mode="magnitude", ratio=0.8)
+        )(sharded_state)
+    out_plain = reset_optimizer_state(state, mode="magnitude", ratio=0.8)
+    a = find_adam_state(out_sharded)
+    b = find_adam_state(out_plain)
+    np.testing.assert_array_equal(
+        np.asarray(a.mu["layer"]["q_proj"]["lora_a"]),
+        np.asarray(b.mu["layer"]["q_proj"]["lora_a"]),
+    )
