@@ -110,7 +110,8 @@ class TrainingConfig:
     dp_size: Optional[int] = None  # data axis; None = fill remaining devices
     fsdp_size: int = 1  # parameter-sharding axis
     tp_size: int = 1  # tensor axis
-    sp_size: int = 1  # sequence (ring attention / context parallel) axis
+    sp_size: int = 1  # sequence (context parallel) axis
+    sp_impl: str = "ring"  # ring (streamed K/V) | ulysses (all-to-all heads)
     remat: bool = False  # gradient checkpointing on decoder layers
     bf16_logits: bool = False  # halve the logits HBM footprint; CE still f32
     # opt-in pallas flash kernel: XLA's fused attention is the robust default

@@ -65,18 +65,23 @@ def dot_product_attention(
         return jax.nn.dot_product_attention(q, k, v, scale=scale, is_causal=causal)
     if impl == "pallas":
         return _pallas_attention(q, k, v, causal=causal, scale=scale)
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         # context parallelism: S sharded over the mesh's sequence axis
         from relora_tpu.parallel.mesh import current_mesh
-        from relora_tpu.parallel.ring_attention import ring_attention
 
         mesh = current_mesh()
         if mesh is None:
             raise RuntimeError(
-                "attention impl 'ring' needs a mesh: call "
+                f"attention impl {impl!r} needs a mesh: call "
                 "relora_tpu.parallel.mesh.set_current_mesh(mesh) first"
             )
-        return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+        if impl == "ring":
+            from relora_tpu.parallel.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+        from relora_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, mesh, causal=causal, scale=scale)
     if impl == "naive":
         return _naive_attention(q, k, v, causal=causal, scale=scale)
     raise ValueError(f"Unknown attention impl {impl!r}")

@@ -66,8 +66,11 @@ _DTYPES = {
 def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingConfig):
     compute_dtype = _DTYPES[cfg.dtype]
     if cfg.sp_size > 1:
-        # context parallelism: sequence sharded over the ring
-        attention_impl = "ring"
+        # context parallelism: sequence sharded; ring streams K/V blocks,
+        # ulysses all-to-alls to head sharding
+        if cfg.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {cfg.sp_impl!r}")
+        attention_impl = cfg.sp_impl
     elif cfg.flash_attention and _on_tpu():
         attention_impl = "pallas"
     else:

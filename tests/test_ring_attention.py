@@ -60,3 +60,46 @@ def test_ring_gradients_match(devices):
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_full_attention(sp, causal, devices):
+    from relora_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=1, sequence=sp))
+    q, k, v = make_qkv(S=32, N=4)
+    spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh, causal=causal))(qs, ks, vs)
+    ref = dot_product_attention(q, k, v, causal=causal, impl="naive")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    assert not out.sharding.is_fully_replicated
+
+
+def test_ulysses_gradients_match(devices):
+    from relora_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=1, sequence=4))
+    q, k, v = make_qkv(B=1, S=16, N=4, H=8)
+    spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence", None, None))
+    args = tuple(jax.device_put(x, spec) for x in (q, k, v))
+    g_u = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(ulysses_attention(a, b, c, mesh, causal=True))),
+        argnums=(0, 1, 2),
+    ))(*args)
+    g_ref = jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(dot_product_attention(a, b, c, causal=True, impl="naive"))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_u, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_ulysses_head_divisibility(devices):
+    from relora_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=1, sequence=4))
+    q, k, v = make_qkv(S=16, N=2)  # 2 heads, sp=4 -> error
+    with pytest.raises(ValueError, match="num_heads"):
+        ulysses_attention(q, k, v, mesh)
