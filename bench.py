@@ -19,19 +19,21 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import sys
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 
 # Watchdog: if the TPU tunnel wedges (observed in this sandbox), emit a
-# diagnostic line instead of hanging forever.
+# diagnostic line instead of hanging forever.  A daemon thread (not SIGALRM):
+# the hang sits inside native device-init code where signal handlers never
+# get a chance to run, but GIL-releasing native waits let threads proceed.
 WATCHDOG_SECS = int(os.environ.get("BENCH_WATCHDOG_SECS", "900"))
 
 
-def _watchdog(signum, frame):
+def _watchdog():
     print(
         json.dumps(
             {
@@ -127,7 +129,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    signal.signal(signal.SIGALRM, _watchdog)
-    signal.alarm(WATCHDOG_SECS)
+    timer = threading.Timer(WATCHDOG_SECS, _watchdog)
+    timer.daemon = True
+    timer.start()
     main()
-    signal.alarm(0)
+    timer.cancel()
