@@ -113,3 +113,23 @@ def test_trainable_scaling_end_to_end(tmp_path):
     s_leaf = np.asarray(trainer.state.params["layers"]["self_attn"]["q_proj"]["lora_s"])
     # one step of training after the merge may have nudged it slightly
     assert np.abs(s_leaf).max() < 0.1
+
+
+@pytest.mark.slow
+def test_evaluate_respects_token_target(tmp_path):
+    """evaluate() stops at target_tokens during training and runs the full
+    set at -1 (torchrun_main.py:144, 984-1003 semantics)."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=256)
+    cfg = make_cfg(tmp_path, num_training_steps=8, relora=None, use_peft=False,
+                   scheduler="cosine", cycle_length=8, save_every=100)
+    trainer = Trainer(cfg, model_cfg=TINY)
+    _, eval_factory = make_iterators(cfg, trainer, data)
+    # full pass: 256 seqs x 15 shifted tokens
+    loss_full, n_full = trainer.evaluate(eval_factory(), target_tokens=-1)
+    assert n_full == 256 * 15
+    # capped pass stops after crossing the target
+    loss_cap, n_cap = trainer.evaluate(eval_factory(), target_tokens=200)
+    assert 200 <= n_cap < n_full
+    assert np.isfinite(loss_full) and np.isfinite(loss_cap)
