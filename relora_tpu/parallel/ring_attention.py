@@ -114,3 +114,166 @@ def ring_attention(
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag layout: causal load balancing
+#
+# With contiguous sequence shards, causal ring attention wastes half its
+# FLOPs: device 0's queries can only ever see block 0, yet every device
+# computes (and masks away) every rotation.  The zigzag layout splits the
+# sequence into 2·ring chunks and gives device d chunks (d, 2·ring-1-d) —
+# one early + one late — so each device's *useful* work is the same, and
+# per-(query-chunk, key-chunk) `lax.cond`s skip the provably-invisible
+# pairs.  Total computed chunk pairs drop from 4·ring² to ~2·ring² + ring.
+#
+# The kernel expects inputs already permuted by `zigzag_permutation` along S
+# (persist the permuted layout across the model for free gains — RoPE uses
+# true positions, so only the loss's token adjacency needs care — or use the
+# convenience wrapper below, which permutes/unpermutes around the call).
+# ---------------------------------------------------------------------------
+
+
+def zigzag_permutation(seq_len: int, ring: int):
+    """perm[i] = original index of permuted position i (gather indices)."""
+    import numpy as np
+
+    if seq_len % (2 * ring):
+        raise ValueError(f"seq_len={seq_len} must divide by 2*ring={2*ring}")
+    C = seq_len // (2 * ring)
+    order = []
+    for d in range(ring):
+        order.extend(range(d * C, (d + 1) * C))
+        order.extend(range((2 * ring - 1 - d) * C, (2 * ring - d) * C))
+    return np.asarray(order)
+
+
+def zigzag_inverse(seq_len: int, ring: int):
+    import numpy as np
+
+    perm = zigzag_permutation(seq_len, ring)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return inv
+
+
+def _zz_positions(block: jax.Array, ring: int, C: int):
+    """(early_pos, late_pos) for the device holding zigzag block ``block``."""
+    early = block * C + jnp.arange(C)
+    late = (2 * ring - 1 - block) * C + jnp.arange(C)
+    return early, late
+
+
+def _zz_fold_pair(carry, q, q_pos, k, v, k_pos, scale):
+    """Fold one (query-chunk, key-chunk) pair into (o, l, m) accumulators."""
+    o, l, m = carry
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k.astype(jnp.float32)) * scale
+    visible = k_pos[None, :] <= q_pos[:, None]
+    scores = jnp.where(visible[None, None], scores, _NEG_INF)
+    blk_max = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, blk_max)
+    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1)
+    o = o * corr[..., None] + jnp.einsum("bnqk,bknh->bnqh", p, v.astype(jnp.float32))
+    return o, l, m_new
+
+
+def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, scale: float):
+    """Per-device body for zigzag layout.  Shapes (B, 2C, N, H) local."""
+    ring = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    B, S2, N, H = q.shape
+    C = S2 // 2
+
+    qE = q[:, :C].astype(jnp.float32)
+    qL = q[:, C:].astype(jnp.float32)
+    myE_pos, myL_pos = _zz_positions(me, ring, C)
+
+    def acc0():
+        return (
+            jnp.zeros((B, N, C, H), jnp.float32),
+            jnp.zeros((B, N, C), jnp.float32),
+            jnp.full((B, N, C), _NEG_INF, jnp.float32),
+        )
+
+    def fold(i, carry):
+        accE, accL, k_blk, v_blk = carry
+        src = (me - i) % ring
+        srcE_pos, srcL_pos = _zz_positions(src, ring, C)
+        kE, vE = k_blk[:, :C], v_blk[:, :C]
+        kL, vL = k_blk[:, C:], v_blk[:, C:]
+
+        # chunk-level visibility: chunk a sees chunk b iff b's start <= a's
+        # end; chunk index order IS position order, so compare block ids.
+        # qE chunk id = me, qL id = 2*ring-1-me; kE id = src, kL id = 2*ring-1-src.
+        qE_id, qL_id = me, 2 * ring - 1 - me
+        kE_id, kL_id = src, 2 * ring - 1 - src
+
+        def maybe(acc, pred, qc, q_pos, kc, vc, k_pos):
+            return jax.lax.cond(
+                pred,
+                lambda c: _zz_fold_pair(c, qc, q_pos, kc, vc, k_pos, scale),
+                lambda c: c,
+                acc,
+            )
+
+        accE = maybe(accE, kE_id <= qE_id, qE, myE_pos, kE, vE, srcE_pos)
+        accE = maybe(accE, kL_id <= qE_id, qE, myE_pos, kL, vL, srcL_pos)
+        accL = maybe(accL, kE_id <= qL_id, qL, myL_pos, kE, vE, srcE_pos)
+        accL = maybe(accL, kL_id <= qL_id, qL, myL_pos, kL, vL, srcL_pos)
+
+        k_blk, v_blk = jax.lax.ppermute(
+            (k_blk, v_blk), axis_name, perm=[(j, (j + 1) % ring) for j in range(ring)]
+        )
+        return accE, accL, k_blk, v_blk
+
+    accE, accL, _, _ = jax.lax.fori_loop(0, ring, fold, (acc0(), acc0(), k, v))
+
+    def finish(acc):
+        o, l, m = acc
+        return (o / jnp.maximum(l[..., None], 1e-30)).transpose(0, 2, 1, 3)
+
+    return jnp.concatenate([finish(accE), finish(accL)], axis=1).astype(q.dtype)
+
+
+def ring_attention_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    scale: Optional[float] = None,
+    seq_axis: str = SEQUENCE_AXIS,
+    inputs_permuted: bool = False,
+) -> jax.Array:
+    """Causal ring attention with zigzag load balancing.
+
+    With ``inputs_permuted=False`` the wrapper gathers into the zigzag layout
+    and scatters back around the kernel (convenient, but pays two reshards);
+    persist the permuted layout end-to-end and pass ``inputs_permuted=True``
+    for the full benefit.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    ring = mesh.shape[seq_axis]
+    S = q.shape[1]
+    spec = P((DATA_AXIS, FSDP_AXIS), seq_axis, None, None)
+
+    if not inputs_permuted:
+        perm = jnp.asarray(zigzag_permutation(S, ring))
+        inv = jnp.asarray(zigzag_inverse(S, ring))
+        q, k, v = (x[:, perm] for x in (q, k, v))
+
+    fn = shard_map(
+        functools.partial(_ring_attention_zigzag_local, axis_name=seq_axis, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    out = fn(q, k, v)
+    if not inputs_permuted:
+        out = out[:, inv]
+    return out
