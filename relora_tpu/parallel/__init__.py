@@ -4,4 +4,13 @@ from relora_tpu.parallel.mesh import (
     LOGICAL_RULES,
     param_shardings,
     batch_sharding,
+    set_current_mesh,
+    current_mesh,
 )
+from relora_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_zigzag,
+    zigzag_permutation,
+    zigzag_inverse,
+)
+from relora_tpu.parallel.ulysses import ulysses_attention
