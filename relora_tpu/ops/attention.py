@@ -65,7 +65,7 @@ def dot_product_attention(
         return jax.nn.dot_product_attention(q, k, v, scale=scale, is_causal=causal)
     if impl == "pallas":
         return _pallas_attention(q, k, v, causal=causal, scale=scale)
-    if impl in ("ring", "ulysses"):
+    if impl in ("ring", "ring_zigzag", "ulysses"):
         # context parallelism: S sharded over the mesh's sequence axis
         from relora_tpu.parallel.mesh import current_mesh
 
@@ -79,6 +79,14 @@ def dot_product_attention(
             from relora_tpu.parallel.ring_attention import ring_attention
 
             return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+        if impl == "ring_zigzag":
+            # inputs travel in the persistent zigzag layout (the train step
+            # permutes tokens/positions/labels consistently)
+            from relora_tpu.parallel.ring_attention import ring_attention_zigzag
+
+            if not causal:
+                raise ValueError("zigzag layout only applies to causal attention")
+            return ring_attention_zigzag(q, k, v, mesh, scale=scale, inputs_permuted=True)
         from relora_tpu.parallel.ulysses import ulysses_attention
 
         return ulysses_attention(q, k, v, mesh, causal=causal, scale=scale)

@@ -18,12 +18,27 @@ def causal_lm_loss(
     logits: jax.Array,
     input_ids: jax.Array,
     mask: Optional[jax.Array] = None,
+    labels: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shifted next-token CE in f32.
 
     Returns ``(mean_loss, n_tokens)`` where n_tokens is the count the mean ran
     over (needed by distributed eval aggregation, torchrun_main.py:159-183).
+
+    With explicit ``labels`` (same shape as input_ids; -100 = ignore, the
+    reference CE's ignore_index), no shift is applied — the caller aligned
+    targets itself (used by the zigzag sequence layout, where position i's
+    successor is not i+1).
     """
+    if labels is not None:
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        safe = jnp.maximum(labels, 0)
+        token_ll = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
+        valid = (labels >= 0).astype(jnp.float32)
+        if mask is not None:
+            valid = valid * mask.astype(jnp.float32)
+        n = jnp.maximum(valid.sum(), 1.0)
+        return -(token_ll * valid).sum() / n, n
     # upcast per-position inside log_softmax; accepts bf16 logits (the
     # bf16_logits option) without a separate f32 materialization
     shift_logits = logits[:, :-1, :].astype(jnp.float32)

@@ -30,6 +30,20 @@ from relora_tpu.train.state import TrainState
 PyTree = Any
 
 
+def _zigzag_inputs(tokens: jax.Array, ring: int):
+    """Permute tokens into the zigzag layout with matching positions and
+    pre-shifted labels (position i's successor is not i+1 after permuting,
+    so the shift happens in original order first)."""
+    from relora_tpu.parallel.ring_attention import zigzag_permutation
+
+    B, S = tokens.shape
+    perm = jnp.asarray(zigzag_permutation(S, ring))  # static at trace time
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((B, 1), -100, tokens.dtype)], axis=1
+    )
+    return tokens[:, perm], labels[:, perm], perm[None, :]
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -38,17 +52,32 @@ def make_train_step(
     clip_grad_norm: float = 1.0,
     schedule: Optional[Callable] = None,
     grad_breakdown: bool = False,
+    zigzag_ring: Optional[int] = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
     ``batch``: int32 token ids shaped ``(grad_accum, microbatch, seq)``.
-    The returned function is pure; jit it with donated state, e.g.::
+    With ``zigzag_ring`` set, the model runs in the zigzag sequence layout
+    (attention impl 'ring_zigzag'): tokens/positions/labels are permuted
+    consistently inside the step.  The returned function is pure; jit it
+    with donated state, e.g.::
 
         step = jax.jit(make_train_step(...), donate_argnums=0)
     """
 
     def loss_fn(trainable: PyTree, frozen: PyTree, tokens: jax.Array, rng) -> jax.Array:
         params = combine(trainable, frozen)
+        if zigzag_ring:
+            tokens_in, labels, positions = _zigzag_inputs(tokens, zigzag_ring)
+            logits = model.apply(
+                {"params": params},
+                tokens_in,
+                positions=positions,
+                deterministic=False,
+                rngs={"dropout": rng},
+            )
+            loss, _ = causal_lm_loss(logits, tokens_in, labels=labels)
+            return loss
         logits = model.apply(
             {"params": params},
             tokens,
@@ -144,7 +173,7 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(model) -> Callable[[PyTree, jax.Array], dict]:
+def make_eval_step(model, zigzag_ring: Optional[int] = None) -> Callable[[PyTree, jax.Array], dict]:
     """``eval_step(params, tokens) -> {loss_sum_weighted, n_tokens}``.
 
     Under jit with a sharded batch, the sums are global (XLA inserts the
@@ -154,8 +183,15 @@ def make_eval_step(model) -> Callable[[PyTree, jax.Array], dict]:
     """
 
     def eval_step(params: PyTree, tokens: jax.Array) -> dict:
-        logits = model.apply({"params": params}, tokens, deterministic=True)
-        loss, n = causal_lm_loss(logits, tokens)
+        if zigzag_ring:
+            tokens_in, labels, positions = _zigzag_inputs(tokens, zigzag_ring)
+            logits = model.apply(
+                {"params": params}, tokens_in, positions=positions, deterministic=True
+            )
+            loss, n = causal_lm_loss(logits, tokens_in, labels=labels)
+        else:
+            logits = model.apply({"params": params}, tokens, deterministic=True)
+            loss, n = causal_lm_loss(logits, tokens)
         return {"loss_sum": loss * n, "n_tokens": n}
 
     return eval_step

@@ -66,10 +66,13 @@ _DTYPES = {
 def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingConfig):
     compute_dtype = _DTYPES[cfg.dtype]
     if cfg.sp_size > 1:
-        # context parallelism: sequence sharded; ring streams K/V blocks,
+        # context parallelism: sequence sharded; ring streams K/V blocks
+        # (ring_zigzag additionally load-balances the causal mask),
         # ulysses all-to-alls to head sharding
-        if cfg.sp_impl not in ("ring", "ulysses"):
-            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {cfg.sp_impl!r}")
+        if cfg.sp_impl not in ("ring", "ring_zigzag", "ulysses"):
+            raise ValueError(
+                f"sp_impl must be 'ring', 'ring_zigzag' or 'ulysses', got {cfg.sp_impl!r}"
+            )
         attention_impl = cfg.sp_impl
     elif cfg.flash_attention and _on_tpu():
         attention_impl = "pallas"
@@ -285,6 +288,7 @@ class Trainer:
         # metric LR is reported relative to the schedule origin, matching the
         # optax-internal count (both freeze on NaN-skipped updates)
         start = self.scheduler_start_step
+        zigzag_ring = cfg.sp_size if (cfg.sp_size > 1 and cfg.sp_impl == "ring_zigzag") else None
         self._train_step = jax.jit(
             make_train_step(
                 self.model,
@@ -293,10 +297,11 @@ class Trainer:
                 clip_grad_norm=cfg.clip_grad_norm,
                 schedule=lambda s: self.schedule(s - start),
                 grad_breakdown=cfg.wandb_watch,
+                zigzag_ring=zigzag_ring,
             ),
             donate_argnums=0,
         )
-        self._eval_step = jax.jit(make_eval_step(self.model))
+        self._eval_step = jax.jit(make_eval_step(self.model, zigzag_ring=zigzag_ring))
         if self.lora_spec is not None:
             spec = self.lora_spec
             self._merge_fn = jax.jit(
