@@ -244,3 +244,35 @@ def test_bf16_logits_option():
     np.testing.assert_array_equal(
         np.argmax(np.asarray(lf), -1), np.argmax(np.asarray(lb.astype(jnp.float32)), -1)
     )
+
+
+def test_causal_lm_loss_explicit_labels_matches_shift():
+    """labels= path (zigzag layout) equals the shifted path on identity
+    permutation, and respects -100 ignore."""
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (2, 8, 16))
+    ids = jax.random.randint(jax.random.fold_in(rng, 1), (2, 8), 0, 16)
+    loss_shift, n_shift = causal_lm_loss(logits, ids)
+    labels = jnp.concatenate([ids[:, 1:], jnp.full((2, 1), -100, ids.dtype)], axis=1)
+    loss_lab, n_lab = causal_lm_loss(logits, ids, labels=labels)
+    # shifted path scores logits[:, :-1] vs ids[:, 1:]; labels path scores
+    # logits[:, i] vs labels[:, i] — same pairs, same mean
+    assert float(n_shift) == float(n_lab) == 2 * 7
+    assert float(loss_shift) == pytest.approx(float(loss_lab), rel=1e-6)
+    # all-ignored rows contribute nothing
+    loss_none, n_none = causal_lm_loss(logits, ids, labels=jnp.full((2, 8), -100))
+    assert float(n_none) == 1.0 and float(loss_none) == 0.0
+
+
+def test_attention_dispatch_errors():
+    from relora_tpu.ops.attention import dot_product_attention
+    from relora_tpu.parallel.mesh import set_current_mesh
+
+    q = jnp.zeros((1, 8, 2, 4))
+    with pytest.raises(ValueError, match="Unknown attention impl"):
+        dot_product_attention(q, q, q, impl="flashy")
+    set_current_mesh(None)
+    with pytest.raises(RuntimeError, match="needs a mesh"):
+        dot_product_attention(q, q, q, impl="ring")
+    with pytest.raises(RuntimeError, match="needs a mesh"):
+        dot_product_attention(q, q, q, impl="ulysses")
