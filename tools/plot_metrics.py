@@ -1,0 +1,77 @@
+"""Training-run visualization from metrics.jsonl (the wandb-dashboard view,
+offline — loss/LR/throughput curves with merge/reset markers).
+
+Covers the reference's loss-curve/debug notebook use cases in one CLI.
+
+Usage::
+
+    python tools/plot_metrics.py ckpts/relora [more_run_dirs...] --out curves.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def load_metrics(run_dir: str):
+    path = os.path.join(run_dir, "metrics.jsonl")
+    rows = [json.loads(l) for l in open(path)]
+    return [r for r in rows if "loss" in r and "update_step" in r]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("run_dirs", nargs="+")
+    p.add_argument("--out", default="curves.png")
+    p.add_argument("--ema", type=float, default=0.0, help="EMA smoothing factor (0 = off)")
+    args = p.parse_args(argv)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    for run_dir in args.run_dirs:
+        rows = load_metrics(run_dir)
+        if not rows:
+            print(f"no metrics in {run_dir}")
+            continue
+        name = os.path.basename(os.path.normpath(run_dir))
+        steps = [r["update_step"] for r in rows]
+        loss = [r["loss"] for r in rows]
+        if args.ema > 0:
+            sm, out = None, []
+            for v in loss:
+                sm = v if sm is None else args.ema * sm + (1 - args.ema) * v
+                out.append(sm)
+            loss = out
+        axes[0].plot(steps, loss, label=name)
+        axes[1].plot(steps, [r.get("lr", 0) for r in rows], label=name)
+        axes[2].plot(steps, [r.get("throughput_tokens", 0) for r in rows], label=name)
+        # merge markers: steps where n_lora_restarts increments
+        prev = 0
+        for r in rows:
+            n = r.get("n_lora_restarts", 0)
+            if n > prev:
+                axes[0].axvline(r["update_step"], color="gray", alpha=0.4, linestyle="--")
+                prev = n
+
+    for ax, title, ylab in zip(
+        axes,
+        ("loss (merges dashed)", "learning rate", "throughput"),
+        ("loss", "lr", "tokens/s"),
+    ):
+        ax.set_title(title)
+        ax.set_xlabel("update step")
+        ax.set_ylabel(ylab)
+        ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
