@@ -1,0 +1,75 @@
+"""Index-construction benchmark: C++ builders vs the NumPy oracles.
+
+Shows why the hot loops are native (the reference made the same call with
+its runtime-compiled pybind11 helpers): sample-index packing walks every
+document of every epoch, which is minutes of pure Python on billion-token
+corpora and milliseconds in C++.
+
+Usage::
+
+    python tools/bench_data.py [--docs 200000] [--samples 200000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--docs", type=int, default=200_000)
+    p.add_argument("--samples", type=int, default=200_000)
+    p.add_argument("--seq_length", type=int, default=2048)
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, ".")
+    from relora_tpu.data.blendable import build_blending_indices_py
+    from relora_tpu.data.native import (
+        build_blending_indices_native,
+        build_sample_idx_native,
+    )
+    from relora_tpu.data.sample_index import (
+        build_doc_idx,
+        build_sample_idx_py,
+        num_epochs_needed,
+    )
+
+    rs = np.random.RandomState(0)
+    sizes = rs.randint(64, 4096, size=args.docs).astype(np.int32)
+    documents = np.arange(args.docs)
+    epochs = num_epochs_needed(int(sizes.sum()), args.seq_length, args.samples)
+    doc_idx = build_doc_idx(documents, epochs, np.random.RandomState(1))
+    print(
+        f"corpus: {args.docs:,} docs, {sizes.sum()/1e6:.1f}M tokens, "
+        f"{epochs} epochs for {args.samples:,} samples of {args.seq_length}"
+    )
+
+    t0 = time.perf_counter()
+    cpp = build_sample_idx_native(sizes, doc_idx, args.seq_length, args.samples)
+    t_cpp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    py = build_sample_idx_py(sizes, doc_idx, args.seq_length, args.samples)
+    t_py = time.perf_counter() - t0
+    assert np.array_equal(np.asarray(cpp, np.int64), py)
+    print(f"sample_idx: C++ {t_cpp*1000:.1f} ms vs NumPy {t_py*1000:.1f} ms "
+          f"({t_py/max(t_cpp,1e-9):.0f}x) — identical outputs")
+
+    weights = np.asarray([0.5, 0.3, 0.2])
+    n = args.samples
+    t0 = time.perf_counter()
+    cpp_b = build_blending_indices_native(weights, n)
+    t_cpp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    py_b = build_blending_indices_py(weights, n)
+    t_py = time.perf_counter() - t0
+    assert np.array_equal(cpp_b[0], py_b[0])
+    print(f"blending:   C++ {t_cpp*1000:.1f} ms vs NumPy {t_py*1000:.1f} ms "
+          f"({t_py/max(t_cpp,1e-9):.0f}x) — identical outputs")
+
+
+if __name__ == "__main__":
+    main()
