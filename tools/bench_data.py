@@ -27,6 +27,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     sys.path.insert(0, ".")
+    from relora_tpu.data import native
     from relora_tpu.data.blendable import build_blending_indices_py
     from relora_tpu.data.native import (
         build_blending_indices_native,
@@ -37,6 +38,11 @@ def main(argv=None):
         build_sample_idx_py,
         num_epochs_needed,
     )
+
+    # build/load the shared object outside the timed window (first use
+    # compiles with g++)
+    if native.load() is None:
+        sys.exit("native helpers unavailable (no compiler?) — nothing to benchmark")
 
     rs = np.random.RandomState(0)
     sizes = rs.randint(64, 4096, size=args.docs).astype(np.int32)
@@ -66,7 +72,7 @@ def main(argv=None):
     t0 = time.perf_counter()
     py_b = build_blending_indices_py(weights, n)
     t_py = time.perf_counter() - t0
-    assert np.array_equal(cpp_b[0], py_b[0])
+    assert np.array_equal(cpp_b[0], py_b[0]) and np.array_equal(cpp_b[1], py_b[1])
     print(f"blending:   C++ {t_cpp*1000:.1f} ms vs NumPy {t_py*1000:.1f} ms "
           f"({t_py/max(t_cpp,1e-9):.0f}x) — identical outputs")
 
