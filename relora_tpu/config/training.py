@@ -114,6 +114,8 @@ class TrainingConfig:
     sp_impl: str = "ring"  # ring (streamed K/V) | ulysses (all-to-all heads)
     remat: bool = False  # gradient checkpointing on decoder layers
     bf16_logits: bool = False  # halve the logits HBM footprint; CE still f32
+    loss_impl: str = "dense"  # dense | chunked (streamed vocab CE, no full logits)
+    vocab_chunk: int = 8192  # chunk size for loss_impl=chunked
     # opt-in pallas flash kernel: XLA's fused attention is the robust default
     # (and the sandbox's remote-compile tunnel stalls on the pallas kernel)
     flash_attention: bool = False

@@ -272,9 +272,15 @@ class LlamaForCausalLM(nn.Module):
         input_ids: jax.Array,
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
+        return_hidden: bool = False,
     ) -> jax.Array:
         x = token_embed(self, input_ids)
         x = decoder_stack(self, x, positions, deterministic, input_ids.shape[1])
+        if return_hidden:
+            # chunked-CE path: the caller streams the lm_head projection
+            # itself (train/losses.chunked_softmax_ce); init always runs with
+            # return_hidden=False so the head param exists
+            return x
         logits = LoRALinear(
             self.config.vocab_size,
             lora=None,  # lm_head is never LoRA-wrapped (target-module policy)

@@ -163,6 +163,7 @@ class GPTNeoXForCausalLM(nn.Module):
         input_ids: jax.Array,
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
+        return_hidden: bool = False,
     ) -> jax.Array:
         cfg = self.config
         x = nn.Embed(
@@ -209,6 +210,8 @@ class GPTNeoXForCausalLM(nn.Module):
                 x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, deterministic)
 
         x = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)
+        if return_hidden:
+            return x
         logits = LoRALinear(
             cfg.vocab_size,
             lora=None,

@@ -51,3 +51,68 @@ def causal_lm_loss(
         return -(token_ll * shift_mask).sum() / n, n
     n = jnp.asarray(token_ll.size, jnp.float32)
     return -token_ll.mean(), n
+
+
+def chunked_softmax_ce(
+    hidden: jax.Array,
+    head_kernel: jax.Array,
+    targets: jax.Array,
+    chunk_size: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    """CE computed from hidden states without materializing (B, S, V) logits.
+
+    Streams over vocab chunks: each scan step projects one logits chunk
+    (bf16 matmul, f32 accumulation), folds it into a running
+    max/log-sum-exp, and gathers the target logit when the target falls in
+    the chunk.  Peak activation memory is O(B·S·chunk) instead of O(B·S·V)
+    — the lever for large-vocab models where f32 logits dominate the loss's
+    HBM traffic.  ``jax.checkpoint`` on the body keeps backward at the same
+    bound (chunk logits recomputed).
+
+    ``targets``: (B, S) with -100 = ignore.  Returns (mean_loss, n_tokens).
+    """
+    B, S, E = hidden.shape
+    V = head_kernel.shape[-1]
+    n_chunks = -(-V // chunk_size)
+    pad_v = n_chunks * chunk_size - V
+    kernel = head_kernel
+    if pad_v:
+        kernel = jnp.pad(head_kernel, ((0, 0), (0, pad_v)))
+    kernel_chunks = kernel.reshape(E, n_chunks, chunk_size).transpose(1, 0, 2)
+
+    h = hidden.reshape(B * S, E)
+    tgt = jnp.maximum(targets.reshape(B * S), 0)
+    valid = (targets.reshape(B * S) >= 0).astype(jnp.float32)
+
+    @jax.checkpoint
+    def fold(carry, inp):
+        m, lse_acc, t_logit = carry
+        idx, kchunk = inp
+        logits = jnp.matmul(h, kchunk.astype(h.dtype)).astype(jnp.float32)
+        if pad_v:
+            # padded lanes of the last chunk must not enter the softmax
+            lane = idx * chunk_size + jnp.arange(chunk_size)
+            logits = jnp.where(lane[None, :] < V, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        lse_acc = lse_acc * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        local = tgt - idx * chunk_size
+        in_chunk = (local >= 0) & (local < chunk_size)
+        gathered = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk_size - 1)[:, None], axis=-1
+        )[:, 0]
+        t_logit = jnp.where(in_chunk, gathered, t_logit)
+        return (m_new, lse_acc, t_logit), None
+
+    init = (
+        jnp.full((B * S,), -jnp.inf, jnp.float32),
+        jnp.zeros((B * S,), jnp.float32),
+        jnp.zeros((B * S,), jnp.float32),
+    )
+    (m, lse_acc, t_logit), _ = jax.lax.scan(
+        fold, init, (jnp.arange(n_chunks), kernel_chunks)
+    )
+    nll = jnp.log(lse_acc) + m - t_logit
+    n = jnp.maximum(valid.sum(), 1.0)
+    return (nll * valid).sum() / n, n

@@ -53,6 +53,8 @@ def make_train_step(
     schedule: Optional[Callable] = None,
     grad_breakdown: bool = False,
     zigzag_ring: Optional[int] = None,
+    loss_impl: str = "dense",  # dense | chunked (streamed vocab CE)
+    vocab_chunk: int = 8192,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -65,26 +67,43 @@ def make_train_step(
         step = jax.jit(make_train_step(...), donate_argnums=0)
     """
 
+    if loss_impl not in ("dense", "chunked"):
+        raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {loss_impl!r}")
+
     def loss_fn(trainable: PyTree, frozen: PyTree, tokens: jax.Array, rng) -> jax.Array:
         params = combine(trainable, frozen)
         if zigzag_ring:
             tokens_in, labels, positions = _zigzag_inputs(tokens, zigzag_ring)
-            logits = model.apply(
+        else:
+            tokens_in, labels, positions = tokens, None, None
+        if loss_impl == "chunked":
+            from relora_tpu.train.losses import chunked_softmax_ce
+
+            hidden = model.apply(
                 {"params": params},
                 tokens_in,
                 positions=positions,
                 deterministic=False,
+                return_hidden=True,
                 rngs={"dropout": rng},
             )
-            loss, _ = causal_lm_loss(logits, tokens_in, labels=labels)
+            if labels is None:
+                B = tokens.shape[0]
+                labels = jnp.concatenate(
+                    [tokens[:, 1:], jnp.full((B, 1), -100, tokens.dtype)], axis=1
+                )
+            loss, _ = chunked_softmax_ce(
+                hidden, params["lm_head"]["kernel"], labels, chunk_size=vocab_chunk
+            )
             return loss
         logits = model.apply(
             {"params": params},
-            tokens,
+            tokens_in,
+            positions=positions,
             deterministic=False,
             rngs={"dropout": rng},
         )
-        loss, _ = causal_lm_loss(logits, tokens)
+        loss, _ = causal_lm_loss(logits, tokens_in, labels=labels)
         return loss
 
     grad_fn = jax.value_and_grad(loss_fn)

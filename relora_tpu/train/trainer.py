@@ -298,6 +298,8 @@ class Trainer:
                 schedule=lambda s: self.schedule(s - start),
                 grad_breakdown=cfg.wandb_watch,
                 zigzag_ring=zigzag_ring,
+                loss_impl=cfg.loss_impl,
+                vocab_chunk=cfg.vocab_chunk,
             ),
             donate_argnums=0,
         )

@@ -276,3 +276,36 @@ def test_attention_dispatch_errors():
         dot_product_attention(q, q, q, impl="ring")
     with pytest.raises(RuntimeError, match="needs a mesh"):
         dot_product_attention(q, q, q, impl="ulysses")
+
+
+def test_chunked_softmax_ce_matches_dense():
+    """Streamed vocab-chunk CE equals dense log_softmax CE in value and
+    gradients (incl. a non-dividing vocab and ignored targets)."""
+    from relora_tpu.train.losses import chunked_softmax_ce
+
+    rng = jax.random.PRNGKey(0)
+    B, S, E, V = 2, 6, 16, 50  # V=50 with chunk 16 -> padded final chunk
+    hidden = jax.random.normal(rng, (B, S, E))
+    kernel = jax.random.normal(jax.random.fold_in(rng, 1), (E, V)) * 0.3
+    targets = jax.random.randint(jax.random.fold_in(rng, 2), (B, S), 0, V)
+    targets = targets.at[0, 0].set(-100)
+
+    def dense(h, k):
+        logits = (h @ k).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(lp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+        valid = (targets >= 0).astype(jnp.float32)
+        return -(ll * valid).sum() / valid.sum()
+
+    def chunked(h, k):
+        return chunked_softmax_ce(h, k, targets, chunk_size=16)[0]
+
+    ld = float(dense(hidden, kernel))
+    lc, n = chunked_softmax_ce(hidden, kernel, targets, chunk_size=16)
+    assert float(n) == B * S - 1
+    assert float(lc) == pytest.approx(ld, rel=1e-5)
+
+    gd = jax.grad(dense, argnums=(0, 1))(hidden, kernel)
+    gc = jax.grad(chunked, argnums=(0, 1))(hidden, kernel)
+    for a, b in zip(gc, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
