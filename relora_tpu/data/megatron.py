@@ -25,7 +25,7 @@ import numpy as np
 import yaml
 
 from relora_tpu.data.blendable import BlendableDataset
-from relora_tpu.data.memmap import MemmapTokenDataset
+from relora_tpu.data.memmap import open_token_dataset
 from relora_tpu.data.sample_index import PackedCausalDataset
 from relora_tpu.utils.logging import get_logger
 
@@ -54,9 +54,9 @@ class MegatronDataConfig:
         known = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in raw.items() if k in known and v not in ("", None)}
         cfg = cls(**kwargs)
-        if cfg.data_impl != "mmap":
+        if cfg.data_impl not in ("mmap", "lazy", "cached", "infer"):
             raise NotImplementedError(
-                f"data_impl={cfg.data_impl!r}: only the mmap format is supported"
+                f"data_impl={cfg.data_impl!r}: supported are mmap/lazy/cached/infer"
             )
         if cfg.data_path is None and not cfg.train_data_paths:
             raise ValueError("config needs train_data_paths or data_path")
@@ -90,8 +90,9 @@ def _build_packed(
     name: str,
     is_coordinator: bool,
     barrier,
+    data_impl: str = "infer",
 ):
-    data = MemmapTokenDataset(prefix)
+    data = open_token_dataset(prefix, data_impl)
     return PackedCausalDataset(
         name=name,
         data=data,
@@ -127,7 +128,7 @@ def build_split_datasets(
             label_paths = mcfg.label_data_paths if name == "train" else None
             parts = []
             for i, p in enumerate(paths):
-                data = MemmapTokenDataset(p)
+                data = open_token_dataset(p, mcfg.data_impl)
                 docs = np.arange(len(data), dtype=np.int32)
                 # each corpus supplies its weighted share of samples (+5%
                 # headroom, as the blend is not exactly proportional)
@@ -143,13 +144,13 @@ def build_split_datasets(
                         is_coordinator=is_coordinator,
                         barrier=barrier,
                         label_data=(
-                            MemmapTokenDataset(label_paths[i]) if label_paths else None
+                            open_token_dataset(label_paths[i], mcfg.data_impl) if label_paths else None
                         ),
                     )
                 )
             out.append(parts[0] if len(parts) == 1 else BlendableDataset(parts, w))
     else:
-        data = MemmapTokenDataset(mcfg.data_path)
+        data = open_token_dataset(mcfg.data_path, mcfg.data_impl)
         ranges = parse_split_string(mcfg.split, len(data))
         for name, rng_, n in zip(names, ranges, num_samples):
             if len(rng_) == 0 or n == 0:
@@ -159,7 +160,7 @@ def build_split_datasets(
             out.append(
                 _build_packed(
                     mcfg.data_path, docs, n, mcfg.seq_length, mcfg.seed,
-                    name, is_coordinator, barrier,
+                    name, is_coordinator, barrier, data_impl=mcfg.data_impl,
                 )
             )
     return tuple(out)
@@ -247,12 +248,12 @@ def build_train_valid_test_iterators(cfg, trainer):
 
     if mcfg.train_data_paths:
         def paths_tokens(paths):
-            return sum(MemmapTokenDataset(p).n_tokens for p in paths) if paths else 0
+            return sum(open_token_dataset(p, mcfg.data_impl).n_tokens for p in paths) if paths else 0
 
         valid_tokens = paths_tokens(mcfg.valid_data_paths)
         test_tokens = paths_tokens(mcfg.test_data_paths)
     else:
-        data = MemmapTokenDataset(mcfg.data_path)
+        data = open_token_dataset(mcfg.data_path, mcfg.data_impl)
         sizes = np.asarray(data.sizes)
         ranges = parse_split_string(mcfg.split, len(data))
         valid_tokens = int(sizes[list(ranges[1])].sum()) if len(ranges[1]) else 0

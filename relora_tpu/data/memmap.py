@@ -107,6 +107,123 @@ class MemmapTokenDataset:
         return int(self.sizes.sum())
 
 
+_LEGACY_MAGIC = b"TNTIDX\x00\x00"
+
+
+class LegacyIndexedDataset:
+    """Reader for the legacy (pre-mmap, fairseq-derived) ``.idx``/``.bin``
+    format (parity: IndexedDataset / IndexedCachedDataset,
+    indexed_dataset.py:133-273).
+
+    Header: magic ``TNTIDX``, <Q version 1, <QQ dtype code + element size,
+    <QQ n_items + n_sizes, <Q doc_idx length; int64 arrays dim_offsets,
+    data_offsets, sizes, doc_idx.  ``cached=True`` reads the whole token
+    buffer into RAM once (the IndexedCachedDataset behavior); otherwise
+    reads seek the file lazily.
+    """
+
+    def __init__(self, prefix: str, cached: bool = False):
+        self.prefix = prefix
+        with open(index_path(prefix), "rb") as f:
+            magic = f.read(len(_LEGACY_MAGIC))
+            if magic != _LEGACY_MAGIC:
+                raise ValueError(f"{index_path(prefix)}: bad legacy magic {magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"unsupported legacy index version {version}")
+            dcode, self.element_size = struct.unpack("<QQ", f.read(16))
+            self.dtype = np.dtype(_CODE_TO_DTYPE[dcode])
+            n_items, n_sizes = struct.unpack("<QQ", f.read(16))
+            (n_docs,) = struct.unpack("<Q", f.read(8))
+            self.dim_offsets = np.fromfile(f, np.int64, n_items + 1)
+            self.data_offsets = np.fromfile(f, np.int64, n_items + 1)
+            self.sizes = np.fromfile(f, np.int64, n_sizes).astype(np.int32)
+            self.doc_idx = np.fromfile(f, np.int64, n_docs)
+        self._file = None
+        self._cache = None
+        if cached:
+            self._cache = np.fromfile(data_path(prefix), dtype=self.dtype)
+
+    def __len__(self) -> int:
+        return len(self.data_offsets) - 1
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        size = int(self.data_offsets[idx + 1] - self.data_offsets[idx])
+        if length is None:
+            length = size - offset
+        start = int(self.data_offsets[idx]) + offset
+        if self._cache is not None:
+            return self._cache[start : start + length]
+        if self._file is None:
+            self._file = open(data_path(self.prefix), "rb", buffering=0)
+        self._file.seek(start * self.element_size)
+        return np.frombuffer(self._file.read(length * self.element_size), dtype=self.dtype)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        return self.get(idx)
+
+    @property
+    def n_tokens(self) -> int:
+        return int(self.data_offsets[-1])
+
+
+class LegacyIndexedWriter:
+    """Writer for the legacy format (parity: IndexedDatasetBuilder,
+    indexed_dataset.py:276-339) — mainly for tests and migration tooling."""
+
+    def __init__(self, prefix: str, dtype: np.dtype = np.dtype(np.int32)):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        os.makedirs(os.path.dirname(os.path.abspath(prefix)), exist_ok=True)
+        self._bin = open(data_path(prefix), "wb")
+        self.data_offsets = [0]
+        self.dim_offsets = [0]
+        self.sizes: list[int] = []
+        self.doc_idx = [0]
+
+    def add_document(self, tokens) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self.data_offsets.append(self.data_offsets[-1] + arr.size)
+        self.sizes.append(arr.size)
+        self.dim_offsets.append(self.dim_offsets[-1] + 1)
+        self.doc_idx.append(len(self.sizes))
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(index_path(self.prefix), "wb") as f:
+            f.write(_LEGACY_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<QQ", _DTYPE_TO_CODE[self.dtype], self.dtype.itemsize))
+            f.write(struct.pack("<QQ", len(self.data_offsets) - 1, len(self.sizes)))
+            f.write(struct.pack("<Q", len(self.doc_idx)))
+            for arr in (self.dim_offsets, self.data_offsets, self.sizes, self.doc_idx):
+                f.write(np.asarray(arr, dtype=np.int64).tobytes())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finalize()
+
+
+def open_token_dataset(prefix: str, impl: str = "infer"):
+    """Open a tokenized corpus by format: 'mmap', 'lazy', 'cached', or
+    'infer' (sniff the index magic — parity: make_dataset/infer_dataset_impl,
+    indexed_dataset.py:36-78)."""
+    if impl == "infer":
+        with open(index_path(prefix), "rb") as f:
+            magic = f.read(9)
+        impl = "mmap" if magic.startswith(_MAGIC[:8]) else "lazy"
+    if impl == "mmap":
+        return MemmapTokenDataset(prefix)
+    if impl == "lazy":
+        return LegacyIndexedDataset(prefix, cached=False)
+    if impl == "cached":
+        return LegacyIndexedDataset(prefix, cached=True)
+    raise ValueError(f"unknown data impl {impl!r}")
+
+
 class MemmapTokenWriter:
     """Streaming writer producing the same ``.idx``/``.bin`` pair
     (parity: MMapIndexedDatasetBuilder, indexed_dataset.py:568-603)."""

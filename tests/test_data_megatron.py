@@ -333,3 +333,41 @@ def test_data_order_invariant_to_host_count(tmp_path):
         # global batch rows = concat of per-host rows, in order
         combined = np.concatenate([a.reshape(-1, 17), b.reshape(-1, 17)])
         np.testing.assert_array_equal(s.reshape(-1, 17), combined)
+
+
+def test_legacy_indexed_dataset_roundtrip(tmp_path):
+    """Legacy fairseq-style format (parity: IndexedDataset /
+    IndexedCachedDataset, indexed_dataset.py:133-273): write, sniff, read
+    lazily and cached, and feed the packed dataset."""
+    from relora_tpu.data.memmap import (
+        LegacyIndexedDataset,
+        LegacyIndexedWriter,
+        open_token_dataset,
+    )
+
+    rs = np.random.RandomState(0)
+    prefix = str(tmp_path / "legacy")
+    docs = [rs.randint(0, 1000, size=rs.randint(5, 60)) for _ in range(40)]
+    with LegacyIndexedWriter(prefix, dtype=np.int32) as w:
+        for d in docs:
+            w.add_document(d)
+
+    for impl in ("lazy", "cached", "infer"):
+        ds = open_token_dataset(prefix, impl)
+        assert len(ds) == 40
+        np.testing.assert_array_equal(np.asarray(ds[7]), docs[7])
+        np.testing.assert_array_equal(
+            np.asarray(ds.get(3, offset=2, length=3)), docs[3][2:5]
+        )
+        assert ds.n_tokens == sum(len(d) for d in docs)
+
+    # mmap files are inferred as mmap
+    mp, _ = write_corpus(tmp_path / "mm", n_docs=5)
+    assert type(open_token_dataset(mp, "infer")).__name__ == "MemmapTokenDataset"
+
+    # legacy corpus through the packed sampler
+    packed = PackedCausalDataset(
+        name="legacy", data=LegacyIndexedDataset(prefix), documents=np.arange(40),
+        num_samples=10, seq_length=16, seed=0,
+    )
+    assert packed[0]["input_ids"].shape == (17,)
