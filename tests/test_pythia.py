@@ -94,3 +94,20 @@ def test_against_hf_torch_neox(parallel_residual):
         {"params": jax.tree_util.tree_map(jnp.asarray, params)}, jnp.asarray(ids_np)
     )
     np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=2e-4, rtol=2e-3)
+
+
+def test_hf_export_roundtrip_neox():
+    """params_to_hf -> hf_to_params is the identity for the NeoX layout."""
+    from relora_tpu.models.hf_compat import hf_to_params, params_to_hf
+
+    model = GPTNeoXForCausalLM(TINY, dtype=jnp.float32)
+    params = init_params(model, jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32))
+    sd = params_to_hf(params, TINY)
+    assert "gpt_neox.embed_in.weight" in sd and "embed_out.weight" in sd
+    back = hf_to_params(sd, TINY, scan_layers=True)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(jax.tree_util.tree_map(jnp.asarray, back))[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
