@@ -420,6 +420,28 @@ class Trainer:
             return jax.device_put(local_batch, shard)
         return jax.make_array_from_process_local_data(shard, local_batch)
 
+    def _prefetched(self, train_iter, depth: int = 2):
+        """Keep ``depth`` batches already transferred to the device, so host
+        reads and H2D copies overlap the running step (device_put is async;
+        starting the next transfer before the current step is consumed keeps
+        it off the critical path)."""
+        import collections
+
+        queue = collections.deque()
+        it = iter(train_iter)
+        try:
+            while len(queue) < depth:
+                queue.append(self.device_batch(next(it)))
+        except StopIteration:
+            pass
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(self.device_batch(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
     # ------------------------------------------------------------------
     def fit(self, train_iter: Iterator[np.ndarray], eval_iter_factory=None) -> dict:
         """The update loop (parity: torchrun_main.py:768-947)."""
@@ -484,7 +506,7 @@ class Trainer:
                 prof.step()
             return True
 
-        for local_batch in train_iter:
+        for batch in self._prefetched(train_iter):
             if self.update_step >= cfg.num_training_steps:
                 exhausted = False
                 break
@@ -494,9 +516,7 @@ class Trainer:
                 self.global_step += self.grad_accum
                 continue
 
-            batch = self.device_batch(local_batch)
-            n_tokens_global = batch.size
-            self.tokens_seen += int(n_tokens_global)
+            self.tokens_seen += int(batch.size)
 
             self.state, metrics = self._train_step(
                 self.state, batch, jax.random.fold_in(rng, self.update_step)

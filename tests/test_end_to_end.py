@@ -286,3 +286,21 @@ def test_reset_schedule_phase_alignment(tmp_path):
     # record where the rewarmup begins
     assert restarts_by_step[8] == 0 and restarts_by_step[9] == 1
     assert restarts_by_step[16] == 1 and restarts_by_step[17] == 2
+
+
+@pytest.mark.slow
+def test_seed_determinism(tmp_path):
+    """Two fresh runs with the same seed produce bit-identical params."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512)
+    outs = []
+    for sub in ("a", "b"):
+        cfg = make_cfg(tmp_path / sub, num_training_steps=8, relora=8, cycle_length=8,
+                       save_every=100)
+        tr = Trainer(cfg, model_cfg=TINY)
+        f, _ = make_iterators(cfg, tr, data)
+        tr.fit(f(), None)
+        outs.append(tr.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[0]), jax.tree_util.tree_leaves(outs[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
