@@ -506,12 +506,18 @@ class Trainer:
                 prof.step()
             return True
 
+        if self.update_step >= cfg.num_training_steps:
+            # already-finished run (e.g. autoresume past the budget): don't
+            # pull/transfer any data
+            train_iter = iter(())
         for batch in self._prefetched(train_iter):
             if self.update_step >= cfg.num_training_steps:
                 exhausted = False
                 break
             if self.update_step in cfg.skip_batches:
-                # manual loss-spike blacklist (torchrun_main.py:772-775)
+                # manual loss-spike blacklist (torchrun_main.py:772-775):
+                # the batch is consumed (data stream stays aligned) but its
+                # transfer is wasted — acceptable for a rare manual blacklist
                 self.update_step += 1
                 self.global_step += self.grad_accum
                 continue
