@@ -1,1 +1,3 @@
-from relora_tpu.train.losses import causal_lm_loss
+from relora_tpu.train.losses import causal_lm_loss, chunked_softmax_ce
+from relora_tpu.train.state import TrainState
+from relora_tpu.train.step import make_eval_step, make_train_step
