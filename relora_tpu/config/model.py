@@ -34,6 +34,9 @@ class ModelConfig:
     intermediate_size: int = 1024
     num_hidden_layers: int = 6
     num_attention_heads: int = 8
+    # grouped-query attention: fewer K/V heads than Q heads (None = MHA, the
+    # reference's models; an extension for modern Llama variants)
+    num_key_value_heads: Optional[int] = None
     max_sequence_length: int = 1024
     rms_norm_eps: float = 1e-6
     layer_norm_eps: float = 1e-5  # neox
@@ -51,6 +54,10 @@ class ModelConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
 
     @property
     def rotary_dim(self) -> int:
@@ -92,6 +99,7 @@ class ModelConfig:
             intermediate_size=d["intermediate_size"],
             num_hidden_layers=d["num_hidden_layers"],
             num_attention_heads=d["num_attention_heads"],
+            num_key_value_heads=d.get("num_key_value_heads"),
             max_sequence_length=d.get("max_sequence_length", d.get("max_position_embeddings", 2048)),
             rms_norm_eps=d.get("rms_norm_eps", 1e-6),
             layer_norm_eps=d.get("layer_norm_eps", 1e-5),

@@ -118,19 +118,25 @@ class LlamaAttention(nn.Module):
     ) -> jax.Array:
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+        n_kv = cfg.kv_heads
         dense = functools.partial(
             LoRALinear, lora=self.lora, dtype=self.dtype, use_bias=False
         )
         q = dense(h, kernel_axes=("embed", "qkv"), name="q_proj")(x, deterministic)
-        k = dense(h, kernel_axes=("embed", "qkv"), name="k_proj")(x, deterministic)
-        v = dense(h, kernel_axes=("embed", "qkv"), name="v_proj")(x, deterministic)
+        k = dense(n_kv * hd, kernel_axes=("embed", "kv"), name="k_proj")(x, deterministic)
+        v = dense(n_kv * hd, kernel_axes=("embed", "kv"), name="v_proj")(x, deterministic)
 
         B, S = x.shape[:2]
         q = q.reshape(B, S, n, hd)
-        k = k.reshape(B, S, n, hd)
-        v = v.reshape(B, S, n, hd)
+        k = k.reshape(B, S, n_kv, hd)
+        v = v.reshape(B, S, n_kv, hd)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
+        if n_kv != n:
+            # grouped-query attention: share each K/V head across n//n_kv
+            # query heads
+            k = jnp.repeat(k, n // n_kv, axis=2)
+            v = jnp.repeat(v, n // n_kv, axis=2)
 
         out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
         out = out.reshape(B, S, h)

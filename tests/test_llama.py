@@ -309,3 +309,35 @@ def test_chunked_softmax_ce_matches_dense():
     gc = jax.grad(chunked, argnums=(0, 1))(hidden, kernel)
     for a, b in zip(gc, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_gqa_against_hf_torch():
+    """GQA (num_key_value_heads < heads) matches HF torch Llama."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    from relora_tpu.models.hf_compat import hf_to_params
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_sequence_length=64,
+    )
+    hf_cfg = HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rotary_emb_base, attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf_model = HFLlama(hf_cfg).eval()
+    params = hf_to_params(hf_model.state_dict(), cfg, scan_layers=True)
+    ids_np = np.random.RandomState(0).randint(0, 256, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids_np)).logits.numpy()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    ours = model.apply({"params": jax.tree_util.tree_map(jnp.asarray, params)}, jnp.asarray(ids_np))
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=2e-4, rtol=2e-3)
