@@ -371,3 +371,25 @@ def test_legacy_indexed_dataset_roundtrip(tmp_path):
         num_samples=10, seq_length=16, seed=0,
     )
     assert packed[0]["input_ids"].shape == (17,)
+
+
+def test_migrate_legacy_to_mmap(tmp_path):
+    from relora_tpu.data.memmap import LegacyIndexedWriter, MemmapTokenDataset
+    import subprocess, sys as _sys
+
+    rs = np.random.RandomState(1)
+    src = str(tmp_path / "old")
+    docs = [rs.randint(0, 500, size=rs.randint(3, 30)) for _ in range(25)]
+    with LegacyIndexedWriter(src, dtype=np.int32) as w:
+        for d in docs:
+            w.add_document(d)
+    dst = str(tmp_path / "new")
+    r = subprocess.run(
+        [_sys.executable, "tools/migrate_dataset.py", "--src", src, "--dst", dst],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+    out = MemmapTokenDataset(dst)
+    assert len(out) == 25
+    for i in (0, 12, 24):
+        np.testing.assert_array_equal(np.asarray(out[i]), docs[i])

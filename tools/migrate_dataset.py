@@ -1,0 +1,42 @@
+"""Convert a legacy (TNTIDX) indexed corpus to the mmap format.
+
+The mmap format is the fast path (zero-copy reads); this migrates old
+fairseq-style corpora once instead of paying the lazy reader forever.
+
+Usage::
+
+    python tools/migrate_dataset.py --src old_corpus --dst new_corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--src", required=True, help="legacy corpus prefix (no extension)")
+    p.add_argument("--dst", required=True, help="output mmap corpus prefix")
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, ".")
+    import numpy as np
+
+    from relora_tpu.data.memmap import LegacyIndexedDataset, MemmapTokenWriter
+
+    src = LegacyIndexedDataset(args.src, cached=False)
+    dtype = src.dtype if src.dtype.itemsize <= 4 else np.dtype(np.int32)
+    t0 = time.time()
+    with MemmapTokenWriter(args.dst, dtype=dtype) as w:
+        for i in range(len(src)):
+            w.add_document(np.asarray(src[i]))
+    print(
+        f"migrated {len(src):,} documents / {src.n_tokens:,} tokens "
+        f"({src.dtype} -> {dtype}) in {time.time()-t0:.1f}s -> {args.dst}.bin/.idx"
+    )
+
+
+if __name__ == "__main__":
+    main()
