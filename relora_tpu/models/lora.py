@@ -85,8 +85,7 @@ class LoRALinear(nn.Module):
                 (1, self.features),
                 jnp.float32,
             )
-            kernel = dequantize_int8(kernel_q, kernel_scale, self.dtype)
-            y = jnp.matmul(x.astype(self.dtype), kernel)
+            y = self._int8_matmul(x, kernel_q, kernel_scale, dequantize_int8)
         elif quantize is not None:
             raise ValueError(f"Unknown quantize mode {quantize!r}")
         else:
@@ -109,6 +108,37 @@ class LoRALinear(nn.Module):
         if self.lora is not None:
             y = y + self._lora_branch(x, in_features, deterministic)
         return y
+
+    def _int8_matmul(self, x, kernel_q, kernel_scale, dequantize_int8) -> jax.Array:
+        """x @ int8 base.  Default: dequantize then matmul (XLA fuses).
+        RELORA_TPU_PALLAS_QUANT=1 opts into the custom pallas kernel that
+        keeps the weight int8 into VMEM (ops/pallas_quant_matmul) when the
+        shapes tile; falls back silently otherwise."""
+        import os
+
+        if os.environ.get("RELORA_TPU_PALLAS_QUANT") == "1":
+            import numpy as np
+
+            from relora_tpu.ops.pallas_quant_matmul import dequant_matmul
+
+            M = int(np.prod(x.shape[:-1]))
+            N = self.features
+            bm = next((b for b in (256, 128, 64, 32, 16, 8) if M % b == 0), None)
+            bn = next((b for b in (256, 128) if N % b == 0), None)
+            if bm and bn:
+                lead = x.shape[:-1]
+                out = dequant_matmul(
+                    x.reshape(M, x.shape[-1]).astype(self.dtype),
+                    kernel_q,
+                    kernel_scale,
+                    block_m=bm,
+                    block_n=bn,
+                    interpret=jax.default_backend() == "cpu",
+                    out_dtype=self.dtype,
+                )
+                return out.reshape(*lead, N)
+        kernel = dequantize_int8(kernel_q, kernel_scale, self.dtype)
+        return jnp.matmul(x.astype(self.dtype), kernel)
 
     def _lora_branch(self, x: jax.Array, in_features: int, deterministic: bool) -> jax.Array:
         """((dropout(x) @ A) @ B) * scale (parity: relora.py:309-323)."""

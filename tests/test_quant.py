@@ -132,3 +132,23 @@ def test_quantized_end_to_end_training(tmp_path):
     assert res["update_step"] == 24 and tr_q.n_lora_restarts == 1
     assert np.isfinite(res["final_eval_loss"])
     assert tr_q.state.params["layers"]["self_attn"]["q_proj"]["kernel_q"].dtype == jnp.int8
+
+
+def test_pallas_quant_matmul_path_matches_default(monkeypatch):
+    """RELORA_TPU_PALLAS_QUANT=1 routes the int8 base through the pallas
+    kernel (interpret mode on CPU) with identical outputs."""
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0, quantize="int8")
+    cfg = ModelConfig(**{**TINY.to_dict(), "intermediate_size": 128, "hidden_size": 32})
+    model = LlamaForCausalLM(cfg, lora=spec, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    params = init_params(model, jax.random.PRNGKey(1), ids)
+    # give the quantized kernels real codes
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, x: jax.random.randint(jax.random.PRNGKey(3), x.shape, -127, 127, jnp.int8)
+        if str(getattr(p[-1], "key", "")) == "kernel_q" else x,
+        params,
+    )
+    out_default = model.apply({"params": params}, ids)
+    monkeypatch.setenv("RELORA_TPU_PALLAS_QUANT", "1")
+    out_pallas = model.apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(out_default), np.asarray(out_pallas), atol=2e-4)
