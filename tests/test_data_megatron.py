@@ -393,3 +393,41 @@ def test_migrate_legacy_to_mmap(tmp_path):
     assert len(out) == 25
     for i in (0, 12, 24):
         np.testing.assert_array_equal(np.asarray(out[i]), docs[i])
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_sample_idx_differential_fuzz(trial):
+    """Randomized differential coverage of the C++ packer vs the NumPy
+    oracle: varied doc-length regimes (incl. many 1-token docs and docs far
+    longer than seq), seq lengths, and epoch counts."""
+    rs = np.random.RandomState(100 + trial)
+    n_docs = rs.randint(5, 400)
+    regime = trial % 4
+    if regime == 0:
+        sizes = rs.randint(1, 8, size=n_docs)  # tiny docs: many crossings
+    elif regime == 1:
+        sizes = rs.randint(1000, 5000, size=n_docs)  # docs >> seq
+    elif regime == 2:
+        sizes = np.where(rs.rand(n_docs) < 0.5, 1, rs.randint(1, 300, size=n_docs))
+    else:
+        sizes = rs.randint(1, 300, size=n_docs)
+    sizes = sizes.astype(np.int32)
+    seq_length = int(rs.choice([8, 32, 129, 512]))
+    documents = np.arange(n_docs)
+    num_samples = int(rs.randint(1, 200))
+    epochs = num_epochs_needed(int(sizes.sum()), seq_length, num_samples)
+    doc_idx = build_doc_idx(documents, epochs, np.random.RandomState(trial))
+    py = build_sample_idx_py(sizes, doc_idx, seq_length, num_samples)
+    cpp = build_sample_idx_native(sizes, doc_idx, seq_length, num_samples)
+    np.testing.assert_array_equal(np.asarray(cpp, np.int64), py)
+
+
+@pytest.mark.parametrize("n_datasets", [2, 5, 16])
+def test_blending_differential_fuzz(n_datasets):
+    rs = np.random.RandomState(n_datasets)
+    w = rs.dirichlet(np.ones(n_datasets))
+    size = int(rs.randint(100, 5000))
+    py = build_blending_indices_py(w, size)
+    cpp = build_blending_indices_native(w, size)
+    np.testing.assert_array_equal(cpp[0], py[0])
+    np.testing.assert_array_equal(cpp[1], py[1])
