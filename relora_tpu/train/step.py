@@ -30,6 +30,13 @@ from relora_tpu.train.state import TrainState
 PyTree = Any
 
 
+def _head_key(model) -> str:
+    """Param name of the output projection ('lm_head' for llama, 'embed_out'
+    for neox) — needed by the chunked-CE path."""
+    cfg = getattr(model, "config", None)
+    return "embed_out" if cfg is not None and cfg.family == "neox" else "lm_head"
+
+
 def _zigzag_inputs(tokens: jax.Array, ring: int):
     """Permute tokens into the zigzag layout with matching positions and
     pre-shifted labels (position i's successor is not i+1 after permuting,
@@ -93,7 +100,7 @@ def make_train_step(
                     [tokens[:, 1:], jnp.full((B, 1), -100, tokens.dtype)], axis=1
                 )
             loss, _ = chunked_softmax_ce(
-                hidden, params["lm_head"]["kernel"], labels, chunk_size=vocab_chunk
+                hidden, params[_head_key(model)]["kernel"], labels, chunk_size=vocab_chunk
             )
             return loss
         logits = model.apply(
@@ -192,7 +199,12 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(model, zigzag_ring: Optional[int] = None) -> Callable[[PyTree, jax.Array], dict]:
+def make_eval_step(
+    model,
+    zigzag_ring: Optional[int] = None,
+    loss_impl: str = "dense",
+    vocab_chunk: int = 8192,
+) -> Callable[[PyTree, jax.Array], dict]:
     """``eval_step(params, tokens) -> {loss_sum_weighted, n_tokens}``.
 
     Under jit with a sharded batch, the sums are global (XLA inserts the
@@ -204,13 +216,31 @@ def make_eval_step(model, zigzag_ring: Optional[int] = None) -> Callable[[PyTree
     def eval_step(params: PyTree, tokens: jax.Array) -> dict:
         if zigzag_ring:
             tokens_in, labels, positions = _zigzag_inputs(tokens, zigzag_ring)
+        else:
+            tokens_in, labels, positions = tokens, None, None
+        if loss_impl == "chunked":
+            from relora_tpu.train.losses import chunked_softmax_ce
+
+            hidden = model.apply(
+                {"params": params},
+                tokens_in,
+                positions=positions,
+                deterministic=True,
+                return_hidden=True,
+            )
+            if labels is None:
+                B = tokens.shape[0]
+                labels = jnp.concatenate(
+                    [tokens[:, 1:], jnp.full((B, 1), -100, tokens.dtype)], axis=1
+                )
+            loss, n = chunked_softmax_ce(
+                hidden, params[_head_key(model)]["kernel"], labels, chunk_size=vocab_chunk
+            )
+        else:
             logits = model.apply(
                 {"params": params}, tokens_in, positions=positions, deterministic=True
             )
             loss, n = causal_lm_loss(logits, tokens_in, labels=labels)
-        else:
-            logits = model.apply({"params": params}, tokens, deterministic=True)
-            loss, n = causal_lm_loss(logits, tokens)
         return {"loss_sum": loss * n, "n_tokens": n}
 
     return eval_step

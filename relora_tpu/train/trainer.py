@@ -303,7 +303,14 @@ class Trainer:
             ),
             donate_argnums=0,
         )
-        self._eval_step = jax.jit(make_eval_step(self.model, zigzag_ring=zigzag_ring))
+        self._eval_step = jax.jit(
+            make_eval_step(
+                self.model,
+                zigzag_ring=zigzag_ring,
+                loss_impl=cfg.loss_impl,
+                vocab_chunk=cfg.vocab_chunk,
+            )
+        )
         if self.lora_spec is not None:
             spec = self.lora_spec
             self._merge_fn = jax.jit(

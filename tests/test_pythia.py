@@ -111,3 +111,26 @@ def test_hf_export_roundtrip_neox():
     ):
         assert pa == pb
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_chunked_loss_step_neox():
+    """loss_impl=chunked resolves the NeoX head (embed_out) correctly."""
+    from relora_tpu.core.optim import build_optimizer
+    from relora_tpu.core.partition import partition
+    from relora_tpu.core.relora import trainable_param_mask
+    from relora_tpu.train.state import TrainState
+    from relora_tpu.train.step import make_train_step
+
+    model = GPTNeoXForCausalLM(TINY, dtype=jnp.float32)
+    params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    mask = trainable_param_mask(params)
+    tx = build_optimizer(schedule=lambda s: 1e-2)
+    state = TrainState.create(params, tx.init(partition(params, mask)[0]))
+    batch = jax.random.randint(jax.random.PRNGKey(1), (1, 2, 16), 0, 256)
+
+    dense = jax.jit(make_train_step(model, tx, mask, schedule=lambda s: 1e-2))
+    chunked = jax.jit(make_train_step(model, tx, mask, schedule=lambda s: 1e-2,
+                                      loss_impl="chunked", vocab_chunk=100))
+    _, m_d = dense(state, batch, jax.random.PRNGKey(2))
+    _, m_c = chunked(state, batch, jax.random.PRNGKey(2))
+    assert float(m_c["loss"]) == pytest.approx(float(m_d["loss"]), rel=1e-5)
