@@ -111,8 +111,12 @@ def build_index_mappings(
     cache_dir = cache_dir or os.path.dirname(os.path.abspath(prefix))
     tokens_per_epoch = int(np.sum(sizes[documents]))
     epochs = num_epochs_needed(tokens_per_epoch, seq_length, num_samples)
+    # the digest covers the document ids themselves, not just their count and
+    # token total — two different subsets with coincident totals (e.g. a moved
+    # split boundary) must not reuse each other's cached mappings
+    doc_digest = hashlib.md5(np.ascontiguousarray(documents).tobytes()).hexdigest()[:16]
     key = hashlib.md5(
-        f"{name}:{len(documents)}:{tokens_per_epoch}:{epochs}:{num_samples}:{seq_length}:{seed}".encode()
+        f"{name}:{doc_digest}:{tokens_per_epoch}:{epochs}:{num_samples}:{seq_length}:{seed}".encode()
     ).hexdigest()[:16]
     base = os.path.join(cache_dir, f"{os.path.basename(prefix)}_{name}_{key}")
     paths = {k: f"{base}_{k}.npy" for k in ("doc_idx", "sample_idx", "shuffle_idx")}

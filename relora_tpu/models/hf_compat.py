@@ -156,21 +156,39 @@ def params_to_hf(params: PyTree, config: ModelConfig) -> Dict[str, np.ndarray]:
     return sd
 
 
+_LORA_KEYS = ("lora_a", "lora_b", "lora_s")
+
+
 def graft_base_weights(params: PyTree, base: PyTree) -> PyTree:
     """Copy base (non-LoRA) weights from ``base`` into an initialized
     (possibly LoRA-carrying) tree ``params`` — the warm-start operation
     (torchrun_main.py:505-553: load full-rank weights, then wrap with LoRA).
 
-    Every leaf of ``base`` must exist in ``params``; LoRA leaves in ``params``
-    keep their fresh init.
+    LoRA leaves are skipped on BOTH sides: leaves in ``params`` keep their
+    fresh init, and ``lora_*`` leaves in ``base`` (a checkpoint from a
+    previous LoRA run) are ignored rather than grafted — warm-starting from
+    an unmerged LoRA checkpoint should merge first (core.relora.merged_params)
+    if the delta is wanted.
     """
     import jax.numpy as jnp
 
-    def walk(p, b):
+    dropped_lora = []
+
+    def walk(p, b, path=""):
         out = dict(p)
         for k, v in b.items():
+            here = f"{path}/{k}" if path else k
+            if k in _LORA_KEYS:
+                dropped_lora.append(here)
+                continue
             if isinstance(v, Mapping):
-                out[k] = walk(p[k], v)
+                if k not in p or not isinstance(p[k], Mapping):
+                    raise KeyError(
+                        f"graft_base_weights: source subtree {here!r} has no "
+                        f"matching subtree in the target params "
+                        f"({'a leaf sits there' if k in p else f'keys there: {sorted(p)}'})"
+                    )
+                out[k] = walk(p[k], v, here)
             elif k == "kernel" and k not in p and "kernel_q" in p:
                 # int8 target: quantize the f32 source on the fly
                 from relora_tpu.ops.quant import quantize_int8
@@ -178,13 +196,28 @@ def graft_base_weights(params: PyTree, base: PyTree) -> PyTree:
                 q, s = quantize_int8(jnp.asarray(v))
                 if p["kernel_q"].shape != q.shape:
                     raise ValueError(
-                        f"shape mismatch for {k}: {p['kernel_q'].shape} vs {q.shape}"
+                        f"shape mismatch for {here}: {p['kernel_q'].shape} vs {q.shape}"
                     )
                 out["kernel_q"], out["kernel_scale"] = q, s
             else:
+                if k not in p:
+                    raise KeyError(
+                        f"graft_base_weights: source leaf {here!r} has no "
+                        f"counterpart in the target params (keys there: {sorted(p)})"
+                    )
                 if p[k].shape != v.shape:
-                    raise ValueError(f"shape mismatch for {k}: {p[k].shape} vs {v.shape}")
+                    raise ValueError(f"shape mismatch for {here}: {p[k].shape} vs {v.shape}")
                 out[k] = jnp.asarray(v, dtype=p[k].dtype)
         return out
 
-    return walk(params, base)
+    grafted = walk(params, base)
+    if dropped_lora:
+        from relora_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            f"graft_base_weights: dropped {len(dropped_lora)} unmerged lora_* "
+            f"leaves from the source checkpoint (e.g. {dropped_lora[0]}); their "
+            "learned delta is NOT carried over — merge first "
+            "(core.relora.merged_params) if you want it"
+        )
+    return grafted

@@ -22,6 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 
@@ -34,6 +35,53 @@ def _dequant_matmul_kernel(x_ref, q_ref, scale_ref, out_ref):
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(out_ref.dtype)
+
+
+def _pallas_forward(bm, bn, interpret, out_dtype, x2, q, scale):
+    M, K = x2.shape
+    N = q.shape[1]
+    return pl.pallas_call(
+        _dequant_matmul_kernel,
+        grid=(M // bm, N // bn),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(x2, q, scale)
+
+
+# pallas_call has no transpose rule, so the kernel gets an explicit VJP.
+# Only the forward benefits from keeping the weight int8 into VMEM; the
+# backward runs the plain dequantize-then-matmul (XLA fuses it) — dx is a
+# bandwidth-bound (M,N)@(N,K) contraction where the weight side is read once
+# anyway.  q is int8 (tangent dtype float0); scale gets its true gradient so
+# jax.grad stays correct even though the frozen base never trains.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _dequant_matmul_vjp(bm, bn, interpret, out_dtype, x2, q, scale):
+    return _pallas_forward(bm, bn, interpret, out_dtype, x2, q, scale)
+
+
+def _dequant_matmul_fwd(bm, bn, interpret, out_dtype, x2, q, scale):
+    return _pallas_forward(bm, bn, interpret, out_dtype, x2, q, scale), (x2, q, scale)
+
+
+def _dequant_matmul_bwd(bm, bn, interpret, out_dtype, res, g):
+    x2, q, scale = res
+    g32 = g.astype(jnp.float32)
+    w = q.astype(jnp.float32) * scale  # (K, N)
+    dx = jnp.matmul(g32, w.T).astype(x2.dtype)
+    # d/dscale[n] sum_m g[m,n] * (x @ q)[m,n]
+    xq = jnp.matmul(x2.astype(jnp.float32), q.astype(jnp.float32))
+    dscale = jnp.sum(g32 * xq, axis=0, keepdims=True).astype(scale.dtype)
+    dq = np.zeros(q.shape, jax.dtypes.float0)
+    return dx, dq, dscale
+
+
+_dequant_matmul_vjp.defvjp(_dequant_matmul_fwd, _dequant_matmul_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret", "out_dtype"))
@@ -51,6 +99,8 @@ def dequant_matmul(
 
     ``x``: (..., M, K) activations; ``q``: (K, N) int8; ``scale``: (1, N) f32.
     M and N must tile by block_m/block_n (pad upstream if not).
+    Differentiable: custom VJP routes the backward through the plain
+    dequantize-then-matmul path (pallas_call itself has no transpose rule).
     """
     out_dtype = out_dtype or x.dtype
     lead = x.shape[:-2] if x.ndim > 2 else ()
@@ -64,18 +114,7 @@ def dequant_matmul(
     if M % bm or N % bn:
         raise ValueError(f"M={M}, N={N} must tile by ({bm}, {bn})")
 
-    out = pl.pallas_call(
-        _dequant_matmul_kernel,
-        grid=(M // bm, N // bn),
-        in_specs=[
-            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
-            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
-        interpret=interpret,
-    )(x2, q, scale)
+    out = _dequant_matmul_vjp(bm, bn, interpret, out_dtype, x2, q, scale)
     if x.ndim != 2:
         out = out.reshape(*lead, x.shape[-2], N)
     return out

@@ -173,7 +173,10 @@ def make_train_step(
             "n_skipped": new_state.n_skipped,
         }
         if schedule is not None:
-            metrics["lr"] = schedule(state.step)
+            # the optax schedule count lives in opt_state and rolls back on
+            # NaN skips, so the count the update actually used is the number
+            # of previously *applied* steps, not state.step
+            metrics["lr"] = schedule(state.step - state.n_skipped)
         if grad_breakdown:
             # per-top-level-subtree grad norms (the observability wandb.watch
             # provided in the reference, torchrun_main.py:624-627)

@@ -65,6 +65,49 @@ def test_lora_init_equals_base_model():
     np.testing.assert_allclose(np.asarray(out_base), np.asarray(out_lora), atol=1e-5)
 
 
+def test_graft_skips_source_lora_and_errors_on_mismatch():
+    """Warm-starting from a previous LoRA run must keep fresh lora init
+    (source lora_a/lora_b ignored); a structure mismatch must raise a
+    descriptive error, not a bare KeyError (ADVICE r1)."""
+    import pytest
+
+    from relora_tpu.models.hf_compat import graft_base_weights
+
+    spec = LoraSpec(r=8, alpha=32, dropout=0.0)
+    lora_model, lora_params = init_model(lora=spec)
+    # source: another LoRA checkpoint with different (nonzero) lora leaves
+    _, source = init_model(lora=spec)
+
+    def poison_lora(tree):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = poison_lora(v)
+            elif k in ("lora_a", "lora_b"):
+                out[k] = jnp.ones_like(v) * 7.0
+            else:
+                out[k] = v
+        return out
+
+    grafted = graft_base_weights(lora_params, poison_lora(source))
+
+    def collect(tree, key, acc):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                collect(v, key, acc)
+            elif k == key:
+                acc.append(v)
+        return acc
+
+    # lora_b stayed at fresh init (zeros), not the source's 7s
+    for leaf in collect(grafted, "lora_b", []):
+        np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+    # structure mismatch raises a descriptive error
+    with pytest.raises(KeyError, match="graft_base_weights"):
+        graft_base_weights(lora_params, {"not_a_real_module": {"kernel": jnp.zeros((2, 2))}})
+
+
 def test_lora_leaves_exist_only_in_target_modules():
     spec = LoraSpec(r=8, alpha=32)
     _, params = init_model(lora=spec)

@@ -118,6 +118,45 @@ def test_nan_gate_skips_update_but_advances_step():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_logged_lr_tracks_applied_schedule_after_skip():
+    """After a NaN skip the reported lr must match the rolled-back schedule
+    count (number of applied updates), not state.step."""
+    schedule = lambda s: 1e-2 * (s + 1)
+    model = LlamaForCausalLM(TINY, lora=None, dtype=jnp.float32)
+    from relora_tpu.models.params_util import init_params as ip
+
+    params = ip(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    mask = trainable_param_mask(params)
+    tx = build_optimizer(schedule=schedule)
+    from relora_tpu.core.partition import partition
+
+    opt_state = tx.init(partition(params, mask)[0])
+    state = TrainState.create(params, opt_state)
+    step = jax.jit(make_train_step(model, tx, mask, schedule=schedule))
+    batch = jax.random.randint(jax.random.PRNGKey(1), (1, 2, 16), 0, 128)
+
+    # poison params -> skipped step; then a clean step
+    poisoned = state.replace(
+        params={
+            **state.params,
+            "lm_head": {"kernel": state.params["lm_head"]["kernel"].at[0, 0].set(jnp.nan)},
+        }
+    )
+    s1, m1 = step(poisoned, batch, jax.random.PRNGKey(0))
+    assert float(m1["skipped"]) == 1.0
+    # repair params, keep counters: next applied update uses schedule count 0
+    repaired = s1.replace(
+        params={
+            **s1.params,
+            "lm_head": {"kernel": jnp.nan_to_num(s1.params["lm_head"]["kernel"])},
+        }
+    )
+    s2, m2 = step(repaired, batch, jax.random.PRNGKey(2))
+    assert float(m2["skipped"]) == 0.0
+    # step index was 1 but 0 updates applied before it -> lr = schedule(0)
+    np.testing.assert_allclose(float(m2["lr"]), schedule(0), rtol=1e-6)
+
+
 def test_eval_step_returns_weighted_sums():
     model, state, _ = build()
     eval_step = jax.jit(make_eval_step(model))
