@@ -132,12 +132,9 @@ class LlamaAttention(nn.Module):
         v = v.reshape(B, S, n_kv, hd)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        if n_kv != n:
-            # grouped-query attention: share each K/V head across n//n_kv
-            # query heads
-            k = jnp.repeat(k, n // n_kv, axis=2)
-            v = jnp.repeat(v, n // n_kv, axis=2)
-
+        # grouped-query attention: K/V keep their n_kv heads all the way into
+        # the attention impls (no jnp.repeat — the repeat would materialize
+        # n/n_kv× the K/V bytes in HBM and ride the ring at full width)
         out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
         out = out.reshape(B, S, h)
         return dense(h, kernel_axes=("qkv", "embed"), name="o_proj")(out, deterministic)

@@ -24,20 +24,40 @@ import jax
 import jax.numpy as jnp
 
 
+def _expand_grouped_kv(q, k, v):
+    """Materialize grouped K/V up to the full query head count (for impls
+    that need equal head counts), validating divisibility at the boundary."""
+    n, n_kv = q.shape[2], k.shape[2]
+    if n == n_kv:
+        return k, v
+    if n % n_kv:
+        raise ValueError(f"num_heads={n} must divide by kv_heads={n_kv}")
+    rep = n // n_kv
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     B, S, N, H = q.shape
-    logits = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    n_kv = k.shape[2]
+    qg = q.astype(jnp.float32).reshape(B, S, n_kv, N // n_kv, H)
+    logits = (
+        jnp.einsum("bqkgh,bskh->bkgqs", qg, k.astype(jnp.float32)) * scale
+    )
     if causal:
         mask = jnp.tril(jnp.ones((S, k.shape[1]), dtype=bool))
-        logits = jnp.where(mask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+        logits = jnp.where(mask[None, None, None, :, :], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bnqk,bknh->bqnh", probs, v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, N, H).astype(q.dtype)
 
 
 def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
 
-    # pallas kernel wants (batch, heads, seq, head_dim)
+    # the pallas kernel wants (batch, heads, seq, head_dim) with equal head
+    # counts — grouped K/V are expanded here (the GQA HBM win still applies
+    # to the projections/ring paths; this materialization is per-call)
+    k, v = _expand_grouped_kv(q, k, v)
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
     out = flash_attention(qt, kt, vt, causal=causal, sm_scale=scale)
     return out.swapaxes(1, 2)

@@ -5,14 +5,22 @@ context 2048, plain SDPA): long sequences are sharded over the ``sequence``
 mesh axis; each device keeps its resident query block and streams K/V blocks
 around the ring with ``ppermute`` over ICI, folding each block into a
 streaming-softmax (flash-style m/l/o) accumulator.  Communication overlaps
-compute block-by-block, memory per device is O(S/ring · S/ring) for scores
-and O(S/ring) for activations, and the result is numerically exact (not an
+compute block-by-block, and the result is numerically exact (not an
 approximation) — verified against single-device attention in tests.
 
+The fold is flash-tiled *within* each resident block too: scores for at most
+``tile`` keys exist at a time, so per-device score memory is
+O(S_loc · tile), not O(S_loc²) — at the long contexts ring attention exists
+for, the dense per-block buffer would dominate HBM.
+
+Grouped-query attention is native: K/V may carry ``n_kv < n`` heads (any
+divisor).  The grouped heads ride the ring un-repeated — ICI traffic and K/V
+block memory shrink by ``n/n_kv`` — and the score einsum contracts against
+the shared head directly instead of a materialized repeat.
+
 Causality is handled at block granularity: a K/V block strictly in the
-future of the resident query block contributes nothing (skipped via masking
-to -inf), the diagonal block applies the intra-block causal mask, and past
-blocks attend densely.
+future of the resident query block contributes nothing, the diagonal block
+applies the intra-block causal mask, and past blocks attend densely.
 """
 
 from __future__ import annotations
@@ -29,6 +37,66 @@ from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS
 
 _NEG_INF = -1e30  # finite sentinel: keeps exp()/where math NaN-free
 
+# per-block key-tile width; scores live as (B, n_kv, G, Q, TILE) f32
+DEFAULT_TILE = 512
+
+
+def _pick_tile(S: int, tile: int) -> int:
+    """Largest divisor of S that is <= tile (S and tile are trace-time ints)."""
+    t = min(tile, S)
+    while S % t:
+        t -= 1
+    return t
+
+
+def _group_q(q: jax.Array, n_kv: int) -> jax.Array:
+    """(B, Q, N, H) -> (B, Q, n_kv, G, H) f32, query head n = kv·G + g."""
+    B, Q, N, H = q.shape
+    if N % n_kv:
+        raise ValueError(f"num_heads={N} must divide by kv heads={n_kv}")
+    return q.astype(jnp.float32).reshape(B, Q, n_kv, N // n_kv, H)
+
+
+def _flash_fold_block(carry, qg, q_pos, k_blk, v_blk, k_pos, *, scale, tile):
+    """Fold one K/V block into flash (o, l, m) accumulators, streaming over
+    key tiles so only (…, Q, tile) scores are live.
+
+    qg: (B, Q, n_kv, G, H) f32 grouped queries; k_blk/v_blk: (B, S, n_kv, H);
+    k_pos: (S,) global key positions, or None for non-causal.
+    carry: o (B, n_kv, G, Q, H), l/m (B, n_kv, G, Q) — all f32.
+    """
+    S = k_blk.shape[1]
+    T = _pick_tile(S, tile)
+
+    def tfold(t, carry):
+        o, l, m = carry
+        kt = jax.lax.dynamic_slice_in_dim(k_blk, t * T, T, axis=1).astype(jnp.float32)
+        vt = jax.lax.dynamic_slice_in_dim(v_blk, t * T, T, axis=1).astype(jnp.float32)
+        scores = jnp.einsum("bqkgh,bskh->bkgqs", qg, kt) * scale
+        if k_pos is not None:
+            kp = jax.lax.dynamic_slice_in_dim(k_pos, t * T, T, axis=0)
+            visible = kp[None, :] <= q_pos[:, None]
+            scores = jnp.where(visible[None, None, None], scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(scores - m_new[..., None])
+        # rows with no visible keys yet: m_new stays at the sentinel and the
+        # exp() above evaluated exp(0)=1 on masked lanes — zero them out
+        p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bkgqs,bskh->bkgqh", p, vt)
+        return o, l, m_new
+
+    return jax.lax.fori_loop(0, S // T, tfold, carry)
+
+
+def _flash_finish(o, l, q_dtype):
+    """(B, n_kv, G, Q, H) accumulators -> (B, Q, N, H) output."""
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    B, K, G, Q, H = out.shape
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Q, K * G, H).astype(q_dtype)
+
 
 def _ring_attention_local(
     q: jax.Array,
@@ -38,52 +106,43 @@ def _ring_attention_local(
     axis_name: str,
     causal: bool,
     scale: float,
+    tile: int,
 ) -> jax.Array:
-    """Per-device body (runs under shard_map).  Shapes (B, S_local, N, H)."""
+    """Per-device body (runs under shard_map).  q: (B, S_local, N, H);
+    k/v: (B, S_local, n_kv, H) with n_kv | N."""
     ring = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     B, S, N, H = q.shape
+    n_kv = k.shape[2]
+    G = N // n_kv
 
-    qf = q.astype(jnp.float32)
+    qg = _group_q(q, n_kv)
     q_pos = me * S + jnp.arange(S)
 
-    o0 = jnp.zeros((B, N, S, H), jnp.float32)
-    l0 = jnp.zeros((B, N, S), jnp.float32)
-    m0 = jnp.full((B, N, S), _NEG_INF, jnp.float32)
+    acc0 = (
+        jnp.zeros((B, n_kv, G, S, H), jnp.float32),
+        jnp.zeros((B, n_kv, G, S), jnp.float32),
+        jnp.full((B, n_kv, G, S), _NEG_INF, jnp.float32),
+    )
 
     def fold(i, carry):
         o, l, m, k_blk, v_blk = carry
         # which global block is resident after i rotations (blocks travel
         # to the next-higher index each step, so we see me, me-1, ...)
         src = (me - i) % ring
-        scores = jnp.einsum("bqnh,bknh->bnqk", qf, k_blk.astype(jnp.float32)) * scale
-        if causal:
-            k_pos = src * S + jnp.arange(S)
-            visible = k_pos[None, :] <= q_pos[:, None]
-            scores = jnp.where(visible[None, None], scores, _NEG_INF)
-
-        blk_max = jnp.max(scores, axis=-1)
-        m_new = jnp.maximum(m, blk_max)
-        p = jnp.exp(scores - m_new[..., None])
-        # rows with no visible keys yet: m_new stays at the sentinel and the
-        # exp() above evaluated exp(0)=1 on masked lanes — zero them out
-        p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
-        correction = jnp.exp(m - m_new)
-        l = l * correction + jnp.sum(p, axis=-1)
-        o = o * correction[..., None] + jnp.einsum(
-            "bnqk,bknh->bnqh", p, v_blk.astype(jnp.float32)
+        k_pos = src * S + jnp.arange(S) if causal else None
+        o, l, m = _flash_fold_block(
+            (o, l, m), qg, q_pos, k_blk, v_blk, k_pos, scale=scale, tile=tile
         )
-
         k_blk, v_blk = jax.lax.ppermute(
             (k_blk, v_blk),
             axis_name,
             perm=[(j, (j + 1) % ring) for j in range(ring)],
         )
-        return o, l, m_new, k_blk, v_blk
+        return o, l, m, k_blk, v_blk
 
-    o, l, m, _, _ = jax.lax.fori_loop(0, ring, fold, (o0, l0, m0, k, v))
-    out = o / jnp.maximum(l[..., None], 1e-30)
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    o, l, m, _, _ = jax.lax.fori_loop(0, ring, fold, (*acc0, k, v))
+    return _flash_finish(o, l, q.dtype)
 
 
 def ring_attention(
@@ -95,16 +154,21 @@ def ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     seq_axis: str = SEQUENCE_AXIS,
+    tile: int = DEFAULT_TILE,
 ) -> jax.Array:
     """Causal attention over (B, S, N, H) arrays whose S dim is sharded on
-    ``seq_axis``.  Composable with jit: shard_map slots into the surrounding
-    GSPMD program."""
+    ``seq_axis``; K/V may carry fewer (grouped) heads.  Composable with jit:
+    shard_map slots into the surrounding GSPMD program."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P((DATA_AXIS, FSDP_AXIS), seq_axis, None, None)
     fn = shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale
+            _ring_attention_local,
+            axis_name=seq_axis,
+            causal=causal,
+            scale=scale,
+            tile=tile,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -164,38 +228,25 @@ def _zz_positions(block: jax.Array, ring: int, C: int):
     return early, late
 
 
-def _zz_fold_pair(carry, q, q_pos, k, v, k_pos, scale):
-    """Fold one (query-chunk, key-chunk) pair into (o, l, m) accumulators."""
-    o, l, m = carry
-    scores = jnp.einsum("bqnh,bknh->bnqk", q, k.astype(jnp.float32)) * scale
-    visible = k_pos[None, :] <= q_pos[:, None]
-    scores = jnp.where(visible[None, None], scores, _NEG_INF)
-    blk_max = jnp.max(scores, axis=-1)
-    m_new = jnp.maximum(m, blk_max)
-    p = jnp.exp(scores - m_new[..., None])
-    p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
-    corr = jnp.exp(m - m_new)
-    l = l * corr + jnp.sum(p, axis=-1)
-    o = o * corr[..., None] + jnp.einsum("bnqk,bknh->bnqh", p, v.astype(jnp.float32))
-    return o, l, m_new
-
-
-def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, scale: float):
-    """Per-device body for zigzag layout.  Shapes (B, 2C, N, H) local."""
+def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, scale: float, tile: int):
+    """Per-device body for zigzag layout.  q: (B, 2C, N, H) local;
+    k/v: (B, 2C, n_kv, H) grouped."""
     ring = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     B, S2, N, H = q.shape
     C = S2 // 2
+    n_kv = k.shape[2]
+    G = N // n_kv
 
-    qE = q[:, :C].astype(jnp.float32)
-    qL = q[:, C:].astype(jnp.float32)
+    qE = _group_q(q[:, :C], n_kv)
+    qL = _group_q(q[:, C:], n_kv)
     myE_pos, myL_pos = _zz_positions(me, ring, C)
 
     def acc0():
         return (
-            jnp.zeros((B, N, C, H), jnp.float32),
-            jnp.zeros((B, N, C), jnp.float32),
-            jnp.full((B, N, C), _NEG_INF, jnp.float32),
+            jnp.zeros((B, n_kv, G, C, H), jnp.float32),
+            jnp.zeros((B, n_kv, G, C), jnp.float32),
+            jnp.full((B, n_kv, G, C), _NEG_INF, jnp.float32),
         )
 
     def fold(i, carry):
@@ -214,7 +265,9 @@ def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, scale: float):
         def maybe(acc, pred, qc, q_pos, kc, vc, k_pos):
             return jax.lax.cond(
                 pred,
-                lambda c: _zz_fold_pair(c, qc, q_pos, kc, vc, k_pos, scale),
+                lambda c: _flash_fold_block(
+                    c, qc, q_pos, kc, vc, k_pos, scale=scale, tile=tile
+                ),
                 lambda c: c,
                 acc,
             )
@@ -230,12 +283,9 @@ def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, scale: float):
         return accE, accL, k_blk, v_blk
 
     accE, accL, _, _ = jax.lax.fori_loop(0, ring, fold, (acc0(), acc0(), k, v))
-
-    def finish(acc):
-        o, l, m = acc
-        return (o / jnp.maximum(l[..., None], 1e-30)).transpose(0, 2, 1, 3)
-
-    return jnp.concatenate([finish(accE), finish(accL)], axis=1).astype(q.dtype)
+    outE = _flash_finish(*accE[:2], q.dtype)
+    outL = _flash_finish(*accL[:2], q.dtype)
+    return jnp.concatenate([outE, outL], axis=1)
 
 
 def ring_attention_zigzag(
@@ -247,8 +297,9 @@ def ring_attention_zigzag(
     scale: Optional[float] = None,
     seq_axis: str = SEQUENCE_AXIS,
     inputs_permuted: bool = False,
+    tile: int = DEFAULT_TILE,
 ) -> jax.Array:
-    """Causal ring attention with zigzag load balancing.
+    """Causal ring attention with zigzag load balancing (K/V may be grouped).
 
     With ``inputs_permuted=False`` the wrapper gathers into the zigzag layout
     and scatters back around the kernel (convenient, but pays two reshards);
@@ -267,7 +318,9 @@ def ring_attention_zigzag(
         q, k, v = (x[:, perm] for x in (q, k, v))
 
     fn = shard_map(
-        functools.partial(_ring_attention_zigzag_local, axis_name=seq_axis, scale=scale),
+        functools.partial(
+            _ring_attention_zigzag_local, axis_name=seq_axis, scale=scale, tile=tile
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -53,10 +53,17 @@ def ulysses_attention(
     inner_impl: str = "xla",
 ) -> jax.Array:
     """Causal attention over (B, S, N, H) with S sharded on ``seq_axis``.
-    ``num_heads`` must divide by the axis size."""
+    ``num_heads`` must divide by the axis size.  Grouped K/V stay grouped
+    when ``n_kv`` also divides by the axis size (the all-to-all then moves
+    ``n_kv/N`` of the K/V bytes); otherwise they are expanded first.
+    """
     sp = mesh.shape[seq_axis]
     if q.shape[2] % sp != 0:
         raise ValueError(f"num_heads={q.shape[2]} must divide by sequence axis size {sp}")
+    if k.shape[2] != q.shape[2] and k.shape[2] % sp != 0:
+        from relora_tpu.ops.attention import _expand_grouped_kv
+
+        k, v = _expand_grouped_kv(q, k, v)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P((DATA_AXIS, FSDP_AXIS), seq_axis, None, None)

@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Loss-parity experiment (BASELINE.md quality target): ReLoRA vs full-rank
+# at matched tokens, llama_35m on a ~100M-token local corpus.
+#
+# Mirrors the reference recipe structure (README.md:69-89): a shared
+# full-rank warmup, then two branches from the same checkpoint —
+#   A) full-rank continuation, lr 1e-3 cosine
+#   B) ReLoRA r=128, merge+reset every 1000 steps, lr 2e-3 cosine_restarts
+#      (the "2x full-rank lr" rule, README.md:19-20)
+# Both train to the same total step count / token count; compare eval loss.
+#
+# Prereq: python tools/build_text_corpus.py --out $CORPUS ... (see README)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CORPUS="${CORPUS:-/tmp/corpus/local400}"
+WORK="${WORK:-/tmp/loss_parity}"
+STEPS_WARMUP="${STEPS_WARMUP:-1000}"
+STEPS_TOTAL="${STEPS_TOTAL:-8000}"
+BATCH="${BATCH:-24}"
+SEQ="${SEQ:-512}"
+mkdir -p "$WORK"
+
+cat > "$WORK/data.yaml" <<EOF
+data_path: $CORPUS
+split: "95,4,1"
+seq_length: $SEQ
+seed: 0
+data_impl: mmap
+EOF
+
+common=(--megatron_dataset_config "$WORK/data.yaml" --model_config llama_35m
+        --batch_size "$BATCH" --total_batch_size "$BATCH" --max_length "$SEQ"
+        --dtype bfloat16 --eval_every 500 --eval_tokens_during_training 500000
+        --keep_checkpoints 2 --seed 0)
+
+if [ ! -d "$WORK/warmup/model_$STEPS_WARMUP" ]; then
+  echo "=== stage 1: shared full-rank warmup ($STEPS_WARMUP steps) ==="
+  python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
+      --warmup_steps 250 --cycle_length "$STEPS_WARMUP" --min_lr_ratio 0.9 \
+      --num_training_steps "$STEPS_WARMUP" --save_every "$STEPS_WARMUP" \
+      --save_dir "$WORK/warmup"
+fi
+
+echo "=== stage 2a: full-rank branch (to $STEPS_TOTAL steps) ==="
+python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
+    --warmup_steps 250 --cycle_length "$STEPS_TOTAL" \
+    --warmed_up_model "$WORK/warmup/model_$STEPS_WARMUP" \
+    --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
+    --save_dir "$WORK/full_rank" --autoresume true
+
+echo "=== stage 2b: ReLoRA branch (to $STEPS_TOTAL steps) ==="
+python main.py "${common[@]}" --lr 2e-3 --use_peft true --lora_r 128 \
+    --relora 1000 --cycle_length 1000 --scheduler cosine_restarts \
+    --warmup_steps 250 --restart_warmup_steps 100 \
+    --reset_optimizer_on_relora true \
+    --warmed_up_model "$WORK/warmup/model_$STEPS_WARMUP" \
+    --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
+    --save_dir "$WORK/relora" --autoresume true
+
+echo "=== results ==="
+python - "$WORK" <<'EOF'
+import json, sys
+for name in ("full_rank", "relora"):
+    evs = []
+    with open(f"{sys.argv[1]}/{name}/metrics.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "final_eval_loss" in rec:
+                evs.append((rec.get("step"), rec["final_eval_loss"]))
+    print(name, evs[-3:])
+EOF

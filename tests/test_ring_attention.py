@@ -148,6 +148,89 @@ def test_zigzag_permutation_properties():
         zigzag_permutation(10, 2)
 
 
+def make_gqa_qkv(B=2, S=32, N=8, NKV=2, H=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, N, H), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, NKV, H), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, NKV, H), jnp.float32)
+    return q, k, v
+
+
+def gqa_oracle(q, k, v, causal=True):
+    """Dense oracle via explicit K/V head repetition (the convention grouped
+    impls must match: kv head j serves query heads j*G..(j+1)*G-1)."""
+    G = q.shape[2] // k.shape[2]
+    return dot_product_attention(
+        q, jnp.repeat(k, G, axis=2), jnp.repeat(v, G, axis=2), causal=causal, impl="naive"
+    )
+
+
+def test_naive_and_xla_gqa_match_repeat_oracle():
+    q, k, v = make_gqa_qkv()
+    ref = gqa_oracle(q, k, v)
+    got_naive = dot_product_attention(q, k, v, causal=True, impl="naive")
+    got_xla = dot_product_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(got_naive), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_xla), np.asarray(ref), atol=2e-3)
+
+
+@pytest.mark.parametrize("ring", [2, 4])
+def test_ring_gqa_matches_oracle(ring, devices):
+    """Grouped K/V ride the ring un-repeated and still give exact attention."""
+    mesh = make_mesh(MeshSpec(data=1, sequence=ring))
+    q, k, v = make_gqa_qkv(S=32)
+    spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh, causal=True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(gqa_oracle(q, k, v)), atol=2e-5)
+
+
+@pytest.mark.parametrize("tile", [4, 8, 16])
+def test_ring_tile_streaming_matches(tile, devices):
+    """The flash key-tile streaming inside each block is tile-size invariant."""
+    mesh = make_mesh(MeshSpec(data=1, sequence=2))
+    q, k, v = make_qkv(S=32)
+    spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    ref = dot_product_attention(q, k, v, causal=True, impl="naive")
+    out = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh, causal=True, tile=tile)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("ring", [2, 4])
+def test_zigzag_gqa_and_tiles_match(ring, devices):
+    from relora_tpu.parallel.ring_attention import ring_attention_zigzag
+
+    mesh = make_mesh(MeshSpec(data=1, sequence=ring))
+    q, k, v = make_gqa_qkv(S=32)
+    spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    ref = gqa_oracle(q, k, v)
+    out = jax.jit(lambda a, b, c: ring_attention_zigzag(a, b, c, mesh, tile=4))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_gqa_matches_oracle(devices):
+    from relora_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=1, sequence=2))
+    q, k, v = make_gqa_qkv(S=16, N=8, NKV=2)  # n_kv=2 divides sp=2: stays grouped
+    spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh, causal=True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(gqa_oracle(q, k, v)), atol=2e-3)
+
+    # n_kv=2 does NOT divide sp=4: falls back to expanded K/V, still exact
+    mesh4 = make_mesh(MeshSpec(data=1, sequence=4))
+    q4, k4, v4 = make_gqa_qkv(S=16, N=8, NKV=2)
+    spec4 = NamedSharding(mesh4, P(("data", "fsdp"), "sequence", None, None))
+    args = tuple(jax.device_put(x, spec4) for x in (q4, k4, v4))
+    out4 = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh4, causal=True))(*args)
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(gqa_oracle(q4, k4, v4)), atol=2e-3)
+
+
 def test_zigzag_gradients_match(devices):
     from relora_tpu.parallel.ring_attention import ring_attention_zigzag
 
