@@ -45,10 +45,28 @@ TRAINING_STATE_FILE = "training_state.json"
 RELORA_CONFIG_FILE = "relora_config.json"
 
 
-def _checkpointer():
-    import orbax.checkpoint as ocp
+_CKPTR = None
 
-    return ocp.StandardCheckpointer()
+
+def _checkpointer():
+    # one process-wide async checkpointer: StandardCheckpointer is an
+    # AsyncCheckpointer — save() returns after the (blocking) device->host
+    # copy and writes to disk in a background thread, so the train loop only
+    # stalls for the copy, not the serialize+write (SURVEY.md §7: Orbax
+    # async).  A singleton keeps one background thread pool and lets
+    # wait_for_save() fence all pending writes.
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def wait_for_save() -> None:
+    """Block until every initiated async checkpoint write has committed."""
+    if _CKPTR is not None:
+        _CKPTR.wait_until_finished()
 
 
 def checkpoint_dir(save_dir: str, update_step: int) -> str:
@@ -68,18 +86,22 @@ def save_checkpoint(
     path = checkpoint_dir(save_dir, update_step)
     os.makedirs(path, exist_ok=True)
     ckptr = _checkpointer()
+    # fence the previous in-flight save (usually a no-op: saves are far
+    # apart), then initiate this one — save() returns after the d2h copy,
+    # the disk write proceeds in the background.  Orbax writes to a tmp dir
+    # and renames on commit, so ``state/`` appears atomically.
+    ckptr.wait_until_finished()
     state_path = os.path.abspath(os.path.join(path, STATE_SUBDIR))
     if os.path.exists(state_path):
         shutil.rmtree(state_path)
     ckptr.save(state_path, state)
-    ckptr.wait_until_finished()
     if jax.process_index() == 0:
         with open(os.path.join(path, TRAINING_STATE_FILE), "w") as f:
             json.dump(training_state, f, indent=2)
         if lora_spec is not None:
             with open(os.path.join(path, RELORA_CONFIG_FILE), "w") as f:
                 json.dump(dataclasses.asdict(lora_spec), f, indent=2)
-    logger.info(f"Saved checkpoint to {path}")
+    logger.info(f"Saving checkpoint to {path} (async)")
     return path
 
 
@@ -90,6 +112,7 @@ def restore_checkpoint(path: str, abstract_state: PyTree) -> PyTree:
     annotations — tells Orbax the target shapes/shardings, so restore places
     shards directly on the mesh."""
     ckptr = _checkpointer()
+    ckptr.wait_until_finished()  # same-process restore right after a save
     return ckptr.restore(os.path.abspath(os.path.join(path, STATE_SUBDIR)), abstract_state)
 
 
@@ -102,6 +125,7 @@ def restore_state_host(path: str) -> PyTree:
     import numpy as np
     import orbax.checkpoint as ocp
 
+    wait_for_save()  # same-process restore right after a save
     state_path = os.path.abspath(os.path.join(path, STATE_SUBDIR))
     if not os.path.isdir(state_path):
         raise FileNotFoundError(f"no checkpoint state at {state_path}")
@@ -143,7 +167,14 @@ def get_last_checkpoint(save_dir: str) -> Tuple[Optional[dict], Optional[str]]:
     (parity: training_utils.get_last_training_state :248-264)."""
     if not os.path.isdir(save_dir):
         return None, None
-    dirs = [d for d in os.listdir(save_dir) if d.startswith("model_")]
+    # only committed checkpoints count: an async save that died mid-write
+    # leaves the JSON but no renamed ``state/`` dir — skip those
+    dirs = [
+        d
+        for d in os.listdir(save_dir)
+        if d.startswith("model_")
+        and os.path.isdir(os.path.join(save_dir, d, STATE_SUBDIR))
+    ]
     if not dirs:
         logger.warning(f"Save directory {save_dir} exists but has no checkpoints; starting fresh")
         return None, None
@@ -153,10 +184,21 @@ def get_last_checkpoint(save_dir: str) -> Tuple[Optional[dict], Optional[str]]:
 
 
 def delete_old_checkpoints(save_dir: str, keep: Optional[int]) -> None:
-    """Keep the newest N checkpoint dirs (parity: training_utils.py:406-418)."""
+    """Keep the newest N checkpoint dirs (parity: training_utils.py:406-418).
+
+    Only *committed* checkpoints (renamed ``state/`` present) count toward
+    the keep budget and are eligible for deletion — with async saves the
+    newest dir may still be in flight, and pruning the last committed one
+    against it would leave nothing restorable if the process dies before
+    the write commits."""
     if keep is None or jax.process_index() != 0:
         return
-    dirs = [d for d in os.listdir(save_dir) if d.startswith("model_")]
+    dirs = [
+        d
+        for d in os.listdir(save_dir)
+        if d.startswith("model_")
+        and os.path.isdir(os.path.join(save_dir, d, STATE_SUBDIR))
+    ]
     if len(dirs) <= keep:
         return
     dirs.sort(key=lambda d: int(d.split("_")[-1]))

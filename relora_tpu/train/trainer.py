@@ -648,6 +648,9 @@ class Trainer:
             )
             result["final_eval_loss"] = final_loss
         self.metrics.finish()
+        # fence pending async checkpoint writes before declaring the run done
+        # (process exit must not truncate an in-flight save)
+        ckpt.wait_for_save()
         logger.info("Training finished")
         return result
 

@@ -43,8 +43,9 @@ if [ ! -d "$WORK/warmup/model_$STEPS_WARMUP" ]; then
 fi
 
 echo "=== stage 2a: full-rank branch (to $STEPS_TOTAL steps) ==="
+# warm-started schedules run over the REMAINING steps (trainer.py:242-251)
 python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
-    --warmup_steps 250 --cycle_length "$STEPS_TOTAL" \
+    --warmup_steps 250 --cycle_length "$((STEPS_TOTAL - STEPS_WARMUP))" \
     --warmed_up_model "$WORK/warmup/model_$STEPS_WARMUP" \
     --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
     --save_dir "$WORK/full_rank" --autoresume true
@@ -67,6 +68,6 @@ for name in ("full_rank", "relora"):
         for line in fh:
             rec = json.loads(line)
             if "final_eval_loss" in rec:
-                evs.append((rec.get("step"), rec["final_eval_loss"]))
+                evs.append((rec.get("_step"), rec["final_eval_loss"]))
     print(name, evs[-3:])
 EOF

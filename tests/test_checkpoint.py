@@ -1,0 +1,104 @@
+"""Checkpoint layer unit tests: async save/restore roundtrip, resharding
+restore under a different device layout, and commit-awareness of the
+autoresume probe."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from relora_tpu.parallel.mesh import MeshSpec, make_mesh
+from relora_tpu.train import checkpoint as ckpt
+from relora_tpu.train.state import TrainState
+
+
+def make_state(mesh, fsdp_axis_parts):
+    sharding = NamedSharding(mesh, P("fsdp", None))
+    params = {
+        "layer": {
+            "kernel": jax.device_put(
+                jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8), sharding
+            ),
+            "bias": jnp.ones((8,), jnp.float32),
+        }
+    }
+    opt_state = {"mu": jax.tree_util.tree_map(jnp.zeros_like, params)}
+    return TrainState.create(params, opt_state)
+
+
+def test_async_save_restore_roundtrip(tmp_path, devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state = make_state(mesh, 8)
+    path = ckpt.save_checkpoint(
+        str(tmp_path), 10, state, {"update_step": 10, "global_step": 10}
+    )
+    # async write: the JSON lands immediately, the state dir commits in the
+    # background; wait_for_save fences it
+    ckpt.wait_for_save()
+    assert os.path.isdir(os.path.join(path, ckpt.STATE_SUBDIR))
+
+    restored = ckpt.restore_checkpoint(path, jax.eval_shape(lambda: state))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["layer"]["kernel"]),
+        np.asarray(state.params["layer"]["kernel"]),
+    )
+
+
+def test_restore_under_different_device_layout(tmp_path, devices):
+    """Save sharded fsdp=8, restore onto an fsdp=2 mesh (the device-count
+    change scenario: pod resize between save and resume)."""
+    mesh8 = make_mesh(MeshSpec(data=1, fsdp=8))
+    state = make_state(mesh8, 8)
+    path = ckpt.save_checkpoint(str(tmp_path), 5, state, {"update_step": 5})
+    ckpt.wait_for_save()
+
+    mesh2 = make_mesh(MeshSpec(data=1, fsdp=2))
+    target_sharding = NamedSharding(mesh2, P("fsdp", None))
+
+    def abstract():
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=target_sharding)
+            if x.ndim == 2
+            else jax.ShapeDtypeStruct(x.shape, x.dtype),
+            state,
+        )
+
+    restored = ckpt.restore_checkpoint(path, abstract())
+    kernel = restored.params["layer"]["kernel"]
+    assert kernel.sharding.mesh.shape["fsdp"] == 2
+    np.testing.assert_array_equal(
+        np.asarray(kernel), np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    )
+
+    # topology-free host restore also works (warm starts / offline tools)
+    host = ckpt.restore_state_host(path)
+    np.testing.assert_array_equal(
+        np.asarray(host["params"]["layer"]["kernel"]),
+        np.arange(64.0, dtype=np.float32).reshape(8, 8),
+    )
+
+
+def test_get_last_checkpoint_skips_uncommitted(tmp_path, devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state = make_state(mesh, 8)
+    ckpt.save_checkpoint(str(tmp_path), 3, state, {"update_step": 3})
+    ckpt.wait_for_save()
+
+    # a newer dir with JSON but no committed state/ (died mid-async-write)
+    dead = os.path.join(str(tmp_path), "model_7")
+    os.makedirs(dead)
+    with open(os.path.join(dead, ckpt.TRAINING_STATE_FILE), "w") as f:
+        json.dump({"update_step": 7}, f)
+
+    ts, path = ckpt.get_last_checkpoint(str(tmp_path))
+    assert ts["update_step"] == 3
+    assert path.endswith("model_3")
+
+    # retention must neither count nor delete the uncommitted dir — with
+    # keep=1 the committed model_3 survives (deleting it against an
+    # in-flight model_7 would leave nothing restorable)
+    ckpt.delete_old_checkpoints(str(tmp_path), keep=1)
+    assert os.path.isdir(os.path.join(str(tmp_path), "model_3", ckpt.STATE_SUBDIR))

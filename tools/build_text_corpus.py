@@ -92,7 +92,7 @@ def main() -> None:
     n_docs = 0
     n_tokens = 0
     with MemmapTokenWriter(args.out, dtype=best_dtype(args.vocab_size)) as w:
-        batch, bpaths = [], []
+        batch = []
 
         def flush():
             nonlocal n_docs, n_tokens
@@ -102,11 +102,9 @@ def main() -> None:
                 n_docs += 1
                 n_tokens += len(ids)
             batch.clear()
-            bpaths.clear()
 
-        for p, text in harvest(args.roots, max_bytes):
+        for _, text in harvest(args.roots, max_bytes):
             batch.append(text)
-            bpaths.append(p)
             if len(batch) >= 256:
                 flush()
         if batch:
