@@ -102,7 +102,9 @@ class TrainingConfig:
 
     # --- numerics ---
     dtype: str = "bfloat16"
-    quantize: Optional[str] = None  # None | "int8"
+    quantize: Optional[str] = None  # None | "int8" | "nf4"
+    # nf4 only: int8-quantize the blockwise scales too (parity:
+    # use_double_quant, args flag -> bnb_4bit_use_double_quant)
     use_double_quant: bool = True
 
     # --- parallelism (TPU-native; replaces distributed_type) ---
@@ -224,8 +226,8 @@ class TrainingConfig:
             self.skip_batches = set(map(int, self.skip_batches.split(",")))
         self.skip_batches = set(self.skip_batches or ())
 
-        if self.quantize not in (None, "int8"):
-            raise ValueError(f"quantize must be None or 'int8', got {self.quantize!r}")
+        if self.quantize not in (None, "int8", "nf4"):
+            raise ValueError(f"quantize must be None, 'int8' or 'nf4', got {self.quantize!r}")
 
         self._finalized = True
         return self

@@ -30,6 +30,15 @@ LORA_A = "lora_a"
 LORA_B = "lora_b"
 LORA_S = "lora_s"
 
+# module param names of the nf4 frozen-base leaves (ops/quant.py owns the
+# name mapping; this tuple is just for membership tests)
+_NF4_PARAM_KEYS = (
+    "kernel_codes",
+    "kernel_bscale_q",
+    "kernel_bscale_scale",
+    "kernel_bscale_offset",
+)
+
 
 @dataclass(frozen=True)
 class LoraSpec:
@@ -43,7 +52,10 @@ class LoraSpec:
     alpha: float = 32.0
     dropout: float = 0.1
     trainable_scaling: bool = False
-    quantize: Optional[str] = None  # None | "int8"
+    quantize: Optional[str] = None  # None | "int8" | "nf4"
+    # nf4 only: int8-quantize the per-block scales themselves (parity:
+    # use_double_quant -> bnb_4bit_use_double_quant, relora.py:57-63)
+    use_double_quant: bool = True
     # pure-LoRA layers with no base weight at all (parity: lora_only,
     # relora.py:209-211; selected when neither relora, force_keep_original
     # nor a warm start needs the full kernel, torchrun_main.py:531-553)
@@ -98,10 +110,13 @@ def frozen_param_mask(params: PyTree) -> PyTree:
                 if isinstance(v, dict):
                     out[k] = walk(v)
                 else:
-                    # int8 codes/scales are never trainable regardless of LoRA
+                    # quantized codes/scales (int8 + nf4 leaves) are never
+                    # trainable regardless of LoRA
                     out[k] = bool(
                         (has_lora and k == "kernel")
                         or k in ("kernel_q", "kernel_scale")
+                        or k.startswith("kernel_codes")
+                        or k.startswith("kernel_bscale")
                     )
             return out
         return False
@@ -201,7 +216,7 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
         if LORA_A not in node:
             return {k: walk(v) for k, v in node.items()}
         key = keys[next(key_iter)]
-        if "kernel" not in node and "kernel_q" not in node:
+        if "kernel" not in node and "kernel_q" not in node and "kernel_codes" not in node:
             # lora_only module: nothing to merge into — skipped entirely,
             # like the reference's warning-and-return (relora.py:271-273)
             return dict(node)
@@ -213,6 +228,21 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
 
             merged = dequantize_int8(node["kernel_q"], node["kernel_scale"]) + lora_delta(node, spec)
             out["kernel_q"], out["kernel_scale"] = quantize_int8(merged)
+        elif "kernel_codes" in node:
+            # nf4 base: dequant -> add -> requant, double-quant preserved
+            # (the exact flow of the reference's 4-bit merge, relora.py:277-287)
+            from relora_tpu.ops.quant import (
+                dequantize_nf4,
+                nf4_leaves_from_module,
+                nf4_leaves_to_module,
+                quantize_nf4,
+            )
+
+            merged = dequantize_nf4(nf4_leaves_from_module(node)) + lora_delta(node, spec)
+            requant = quantize_nf4(
+                merged, double_quant=node["kernel_bscale_q"].dtype == jnp.int8
+            )
+            out.update(nf4_leaves_to_module(requant))
         else:
             kernel = node["kernel"]
             merged = kernel.astype(jnp.float32) + lora_delta(node, spec)
@@ -228,20 +258,37 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
 
 def merged_params(params: PyTree, spec: LoraSpec) -> PyTree:
     """Merge without reinit: returns params of the equivalent full-rank model
-    (for export / saving an HF-compatible checkpoint), LoRA leaves dropped."""
+    (for export / saving an HF-compatible checkpoint), LoRA leaves dropped.
+
+    Quantized bases (int8 / nf4) are dequantized into a plain f32 ``kernel``
+    — the export target is the HF full-precision layout."""
 
     def walk(node):
         if not isinstance(node, dict):
             return node
         if LORA_A not in node:
             return {k: walk(v) for k, v in node.items()}
+        quant_keys = ("kernel_q", "kernel_scale", *_NF4_PARAM_KEYS)
         out = {
             k: v
             for k, v in node.items()
-            if k not in (LORA_A, LORA_B, LORA_S)
+            if k not in (LORA_A, LORA_B, LORA_S) and k not in quant_keys
         }
-        kernel = node["kernel"]
-        out["kernel"] = (kernel.astype(jnp.float32) + lora_delta(node, spec)).astype(kernel.dtype)
+        if "kernel_q" in node:
+            from relora_tpu.ops.quant import dequantize_int8
+
+            base = dequantize_int8(node["kernel_q"], node["kernel_scale"])
+            out["kernel"] = base + lora_delta(node, spec)
+        elif "kernel_codes" in node:
+            from relora_tpu.ops.quant import dequantize_nf4, nf4_leaves_from_module
+
+            base = dequantize_nf4(nf4_leaves_from_module(node))
+            out["kernel"] = base + lora_delta(node, spec)
+        else:
+            kernel = node["kernel"]
+            out["kernel"] = (kernel.astype(jnp.float32) + lora_delta(node, spec)).astype(
+                kernel.dtype
+            )
         return out
 
     return walk(params)

@@ -199,6 +199,20 @@ def graft_base_weights(params: PyTree, base: PyTree) -> PyTree:
                         f"shape mismatch for {here}: {p['kernel_q'].shape} vs {q.shape}"
                     )
                 out["kernel_q"], out["kernel_scale"] = q, s
+            elif k == "kernel" and k not in p and "kernel_codes" in p:
+                # nf4 target: quantize the f32 source on the fly, preserving
+                # the target's double-quant layout (bscale_q dtype)
+                from relora_tpu.ops.quant import nf4_leaves_to_module, quantize_nf4
+
+                leaves = quantize_nf4(
+                    jnp.asarray(v), double_quant=p["kernel_bscale_q"].dtype == jnp.int8
+                )
+                if p["kernel_codes"].shape != leaves["codes"].shape:
+                    raise ValueError(
+                        f"shape mismatch for {here}: "
+                        f"{p['kernel_codes'].shape} vs {leaves['codes'].shape}"
+                    )
+                out.update(nf4_leaves_to_module(leaves))
             else:
                 if k not in p:
                     raise KeyError(

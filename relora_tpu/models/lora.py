@@ -86,6 +86,8 @@ class LoRALinear(nn.Module):
                 jnp.float32,
             )
             y = self._int8_matmul(x, kernel_q, kernel_scale, dequantize_int8)
+        elif quantize == "nf4":
+            y = self._nf4_matmul(x, in_features)
         elif quantize is not None:
             raise ValueError(f"Unknown quantize mode {quantize!r}")
         else:
@@ -138,6 +140,57 @@ class LoRALinear(nn.Module):
                 )
                 return out.reshape(*lead, N)
         kernel = dequantize_int8(kernel_q, kernel_scale, self.dtype)
+        return jnp.matmul(x.astype(self.dtype), kernel)
+
+    def _nf4_matmul(self, x: jax.Array, in_features: int) -> jax.Array:
+        """x @ nf4 base (~0.53 bytes/element in HBM; see ops/quant.py).
+
+        Like int8, a fresh init is W=0 (all codes point at codebook entry 7
+        == 0.0) — only meaningful warm-started via graft_base_weights, which
+        nf4-quantizes f32 sources on the fly.  Double-quant is the LoraSpec's
+        ``use_double_quant`` (it sets the bscale_q dtype at init)."""
+        from relora_tpu.ops.quant import dequantize_nf4, nf4_block_for
+
+        block = nf4_block_for(in_features)
+        dq = self.lora.use_double_quant if self.lora else True
+        leaves = {
+            "codes": self.param(
+                "kernel_codes",
+                nn.with_logical_partitioning(
+                    # codebook entry 7 is exactly 0.0 -> W=0 at fresh init
+                    lambda key, shape, dtype: jnp.full(shape, 0x77, dtype),
+                    self.kernel_axes,
+                ),
+                (in_features // 2, self.features),
+                jnp.uint8,
+            ),
+            "bscale_q": self.param(
+                "kernel_bscale_q",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init() if dq else nn.initializers.ones_init(),
+                    (None, self.kernel_axes[1]),
+                ),
+                (in_features // block, self.features),
+                jnp.int8 if dq else jnp.float32,
+            ),
+            "bscale_scale": self.param(
+                "kernel_bscale_scale",
+                nn.with_logical_partitioning(
+                    nn.initializers.ones_init(), (None, self.kernel_axes[1])
+                ),
+                (1, self.features),
+                jnp.float32,
+            ),
+            "bscale_offset": self.param(
+                "kernel_bscale_offset",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), (None, self.kernel_axes[1])
+                ),
+                (1, self.features),
+                jnp.float32,
+            ),
+        }
+        kernel = dequantize_nf4(leaves, self.dtype)
         return jnp.matmul(x.astype(self.dtype), kernel)
 
     def _lora_branch(self, x: jax.Array, in_features: int, deterministic: bool) -> jax.Array:

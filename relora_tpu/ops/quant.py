@@ -1,17 +1,26 @@
-"""Int8 quantization for frozen base weights.
+"""Int8 / NF4 quantization for frozen base weights.
 
 TPU-native replacement for the reference's bitsandbytes 4/8-bit path
-(relora.py:10-11, 222-238): the frozen kernel is stored as int8 with an f32
-per-output-channel scale (symmetric absmax), halving its HBM footprint vs
-bf16 and quartering vs f32.  Forward dequantizes into the compute dtype —
-XLA fuses the dequant into the matmul epilogue — and merge-and-reinit does
-dequant → add ΔW → requant, the same flow as the reference's 4-bit merge
-(relora.py:277-287).
+(relora.py:10-11, 222-238):
+
+- **int8**: per-output-channel symmetric absmax — 1 byte/element, the fast
+  simple mode.
+- **nf4**: 4-bit NormalFloat codes (the bitsandbytes ``nf4`` data type:
+  a 16-entry codebook of normal-distribution quantiles) with blockwise
+  absmax scales, two codes packed per uint8 byte — ~0.53 bytes/element.
+  With **double quantization** (``use_double_quant``, relora.py:57-63 →
+  bnb ``bnb_4bit_use_double_quant``) the per-block f32 scales are
+  themselves int8-quantized against a per-output-channel offset+scale,
+  cutting scale overhead 4×.
+
+Forward dequantizes into the compute dtype — XLA fuses the dequant into the
+matmul epilogue — and merge-and-reinit does dequant → add ΔW → requant, the
+same flow as the reference's 4-bit merge (relora.py:277-287).
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,3 +37,164 @@ def quantize_int8(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
     return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# NF4: 4-bit NormalFloat (QLoRA) with blockwise scales + double quantization
+# ---------------------------------------------------------------------------
+
+# the bitsandbytes nf4 codebook: 16 quantiles of N(0,1) normalized to [-1, 1].
+# numpy (not jnp): this module may be first imported inside a jit trace, and a
+# module-level jnp constant created there would be a tracer that outlives it.
+import numpy as _np  # noqa: E402
+
+NF4_CODEBOOK = _np.asarray(
+    [
+        -1.0,
+        -0.6961928009986877,
+        -0.5250730514526367,
+        -0.39491748809814453,
+        -0.28444138169288635,
+        -0.18477343022823334,
+        -0.09105003625154495,
+        0.0,
+        0.07958029955625534,
+        0.16093020141124725,
+        0.24611230194568634,
+        0.33791524171829224,
+        0.44070982933044434,
+        0.5626170039176941,
+        0.7229568362236023,
+        1.0,
+    ],
+    dtype=_np.float32,
+)
+
+NF4_BLOCK = 64  # bnb default blocksize for 4-bit
+
+
+def nf4_block_for(in_features: int, block: int = NF4_BLOCK) -> int:
+    """Largest power-of-two <= ``block`` dividing ``in_features`` (bnb pads
+    the flattened tensor instead; per-column blocks make padding awkward, so
+    odd widths get proportionally more scales — slightly more accurate,
+    slightly more scale overhead)."""
+    b = block
+    while b > 1 and in_features % b:
+        b //= 2
+    if in_features % b or in_features % 2:
+        raise ValueError(f"nf4 needs even in_features, got {in_features}")
+    return b
+
+
+def _nf4_encode(x: jax.Array) -> jax.Array:
+    """Nearest-codebook-entry index for values in [-1, 1] via the midpoint
+    boundaries (15 comparisons, vectorized)."""
+    mids = (NF4_CODEBOOK[1:] + NF4_CODEBOOK[:-1]) / 2.0
+    return jnp.sum(x[..., None] > mids, axis=-1).astype(jnp.uint8)
+
+
+def quantize_nf4(
+    w: jax.Array, *, block: int = NF4_BLOCK, double_quant: bool = True
+) -> Dict[str, jax.Array]:
+    """(in, out) float -> packed nf4 leaves.
+
+    Returns a dict of arrays (the LoRALinear param leaves):
+
+    - ``codes``  (in//2, out) uint8 — two 4-bit codes per byte along the
+      *in* axis (low nibble = even row, high nibble = odd row)
+    - ``bscale_q`` (in//block, out) int8 (double_quant) or f32 (not)
+    - ``bscale_scale`` / ``bscale_offset`` (1, out) f32 — only meaningful
+      under double_quant (identity values otherwise, kept for a stable
+      pytree structure)
+
+    Leading axes (scan-stacked layer kernels) are vmapped over.
+    """
+    if w.ndim > 2:
+        return jax.vmap(
+            lambda ww: quantize_nf4(ww, block=block, double_quant=double_quant)
+        )(w)
+    in_f, out_f = w.shape
+    block = nf4_block_for(in_f, block)
+    w32 = w.astype(jnp.float32)
+    blocks = w32.reshape(in_f // block, block, out_f)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)  # (nb, out)
+    bscale = jnp.maximum(absmax, 1e-12)
+    normalized = blocks / bscale[:, None, :]
+    idx = _nf4_encode(normalized).reshape(in_f, out_f)
+    low = idx[0::2]
+    high = idx[1::2]
+    codes = (low | (high << 4)).astype(jnp.uint8)
+
+    if double_quant:
+        offset = jnp.mean(bscale, axis=0, keepdims=True)  # (1, out)
+        resid = bscale - offset
+        s2 = jnp.maximum(jnp.max(jnp.abs(resid), axis=0, keepdims=True) / 127.0, 1e-12)
+        bscale_q = jnp.clip(jnp.round(resid / s2), -127, 127).astype(jnp.int8)
+        return {
+            "codes": codes,
+            "bscale_q": bscale_q,
+            "bscale_scale": s2.astype(jnp.float32),
+            "bscale_offset": offset.astype(jnp.float32),
+        }
+    return {
+        "codes": codes,
+        "bscale_q": bscale.astype(jnp.float32),
+        "bscale_scale": jnp.ones((1, out_f), jnp.float32),
+        "bscale_offset": jnp.zeros((1, out_f), jnp.float32),
+    }
+
+
+def dequantize_nf4(leaves: Dict[str, jax.Array], dtype=jnp.float32) -> jax.Array:
+    """Inverse of ``quantize_nf4`` -> (in, out) array in ``dtype``."""
+    codes = leaves["codes"]
+    if codes.ndim > 2:
+        return jax.vmap(lambda lv: dequantize_nf4(lv, dtype))(leaves)
+    half, out_f = codes.shape
+    low = (codes & 0xF).astype(jnp.int32)
+    high = (codes >> 4).astype(jnp.int32)
+    idx = jnp.stack([low, high], axis=1).reshape(half * 2, out_f)
+    vals = jnp.asarray(NF4_CODEBOOK)[idx]  # (in, out) in [-1, 1]
+    bscale_q = leaves["bscale_q"]
+    if bscale_q.dtype == jnp.int8:
+        bscale = (
+            bscale_q.astype(jnp.float32) * leaves["bscale_scale"] + leaves["bscale_offset"]
+        )
+    else:
+        bscale = bscale_q
+    nb = bscale.shape[0]
+    block = (half * 2) // nb
+    w = vals.reshape(nb, block, out_f) * bscale[:, None, :]
+    return w.reshape(half * 2, out_f).astype(dtype)
+
+
+# the module-param-name <-> quantize_nf4-leaf-name correspondence, shared by
+# merge/graft/export so a new nf4 leaf only needs to be added here
+NF4_MODULE_LEAVES = {
+    "kernel_codes": "codes",
+    "kernel_bscale_q": "bscale_q",
+    "kernel_bscale_scale": "bscale_scale",
+    "kernel_bscale_offset": "bscale_offset",
+}
+
+
+def nf4_leaves_from_module(module: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Pull the nf4 leaves out of a LoRALinear param dict."""
+    return {leaf: module[param] for param, leaf in NF4_MODULE_LEAVES.items()}
+
+
+def nf4_leaves_to_module(leaves: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Inverse of ``nf4_leaves_from_module`` (param-dict key names)."""
+    return {param: leaves[leaf] for param, leaf in NF4_MODULE_LEAVES.items()}
+
+
+def quant_bytes_per_param(mode: str, in_f: int, out_f: int, block: int = NF4_BLOCK) -> float:
+    """Stored bytes per weight element for an (in, out) kernel under
+    ``mode`` — the HBM-footprint arithmetic used by tests and tools."""
+    n = in_f * out_f
+    if mode == "int8":
+        return (n + 4 * out_f) / n
+    if mode == "nf4":  # double-quant layout
+        return (n / 2 + (in_f // block) * out_f + 8 * out_f) / n
+    if mode == "nf4-f32scale":
+        return (n / 2 + 4 * (in_f // block) * out_f + 8 * out_f) / n
+    raise ValueError(f"unknown mode {mode!r}")

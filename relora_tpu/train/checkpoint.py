@@ -167,20 +167,27 @@ def get_last_checkpoint(save_dir: str) -> Tuple[Optional[dict], Optional[str]]:
     (parity: training_utils.get_last_training_state :248-264)."""
     if not os.path.isdir(save_dir):
         return None, None
-    # only committed checkpoints count: an async save that died mid-write
-    # leaves the JSON but no renamed ``state/`` dir — skip those
+    dirs = _committed_checkpoints(save_dir)
+    if not dirs:
+        logger.warning(f"Save directory {save_dir} exists but has no checkpoints; starting fresh")
+        return None, None
+    path = os.path.join(save_dir, dirs[-1])
+    return load_training_state(path), path
+
+
+def _committed_checkpoints(save_dir: str) -> list:
+    """``model_*`` dirs with a committed ``state/`` (Orbax renames the tmp dir
+    into place on commit), sorted by step.  An async save that died mid-write
+    leaves the JSON but no ``state/`` — those are invisible to both the
+    autoresume probe and retention."""
     dirs = [
         d
         for d in os.listdir(save_dir)
         if d.startswith("model_")
         and os.path.isdir(os.path.join(save_dir, d, STATE_SUBDIR))
     ]
-    if not dirs:
-        logger.warning(f"Save directory {save_dir} exists but has no checkpoints; starting fresh")
-        return None, None
     dirs.sort(key=lambda d: int(d.split("_")[-1]))
-    path = os.path.join(save_dir, dirs[-1])
-    return load_training_state(path), path
+    return dirs
 
 
 def delete_old_checkpoints(save_dir: str, keep: Optional[int]) -> None:
@@ -193,15 +200,9 @@ def delete_old_checkpoints(save_dir: str, keep: Optional[int]) -> None:
     the write commits."""
     if keep is None or jax.process_index() != 0:
         return
-    dirs = [
-        d
-        for d in os.listdir(save_dir)
-        if d.startswith("model_")
-        and os.path.isdir(os.path.join(save_dir, d, STATE_SUBDIR))
-    ]
+    dirs = _committed_checkpoints(save_dir)
     if len(dirs) <= keep:
         return
-    dirs.sort(key=lambda d: int(d.split("_")[-1]))
     for d in dirs[:-keep]:
         full = os.path.join(save_dir, d)
         logger.info(f"Deleting old checkpoint {full}")

@@ -157,6 +157,7 @@ class Trainer:
                 dropout=cfg.lora_dropout,
                 trainable_scaling=cfg.train_scaling,
                 quantize=cfg.quantize,
+                use_double_quant=cfg.use_double_quant,
                 lora_only=not need_linear_weight,
             )
             if cfg.use_peft

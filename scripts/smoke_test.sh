@@ -50,6 +50,12 @@ python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_lengt
     --quantize int8 --warmed_up_model "$WORK/full/model_8" \
     --num_training_steps 24 --save_every 100 --save_dir "$WORK/relora_q"
 
+echo "=== 3b. ReLoRA + nf4 double-quant base ==="
+python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --quantize nf4 --use_double_quant true --warmed_up_model "$WORK/full/model_8" \
+    --num_training_steps 24 --save_every 100 --save_dir "$WORK/relora_nf4"
+
 echo "=== 4. autoresume continues run 2 ==="
 python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
     --scheduler cosine_restarts --restart_warmup_steps 2 \
