@@ -30,15 +30,6 @@ LORA_A = "lora_a"
 LORA_B = "lora_b"
 LORA_S = "lora_s"
 
-# module param names of the nf4 frozen-base leaves (ops/quant.py owns the
-# name mapping; this tuple is just for membership tests)
-_NF4_PARAM_KEYS = (
-    "kernel_codes",
-    "kernel_bscale_q",
-    "kernel_bscale_scale",
-    "kernel_bscale_offset",
-)
-
 
 @dataclass(frozen=True)
 class LoraSpec:
@@ -268,7 +259,9 @@ def merged_params(params: PyTree, spec: LoraSpec) -> PyTree:
             return node
         if LORA_A not in node:
             return {k: walk(v) for k, v in node.items()}
-        quant_keys = ("kernel_q", "kernel_scale", *_NF4_PARAM_KEYS)
+        from relora_tpu.ops.quant import NF4_MODULE_LEAVES
+
+        quant_keys = ("kernel_q", "kernel_scale", *NF4_MODULE_LEAVES)
         out = {
             k: v
             for k, v in node.items()

@@ -75,12 +75,18 @@ def load() -> Optional[ctypes.CDLL]:
             i64p, ctypes.c_int64, i32p, ctypes.c_int32, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_double, ctypes.c_uint32,
         ]
-        for fn in ("relora_count_bert_mapping", "relora_count_block_mapping"):
-            getattr(lib, fn).argtypes = list(bert_args)
-            getattr(lib, fn).restype = ctypes.c_int64
-        for fn in ("relora_fill_bert_mapping", "relora_fill_block_mapping"):
-            getattr(lib, fn).argtypes = list(bert_args) + [i64p]
-            getattr(lib, fn).restype = None
+        lib.relora_count_bert_mapping.argtypes = list(bert_args)
+        lib.relora_count_bert_mapping.restype = ctypes.c_int64
+        lib.relora_fill_bert_mapping.argtypes = list(bert_args) + [i64p]
+        lib.relora_fill_bert_mapping.restype = None
+        blocks_args = [
+            i64p, ctypes.c_int64, i32p, i32p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.relora_count_blocks_mapping.argtypes = list(blocks_args)
+        lib.relora_count_blocks_mapping.restype = ctypes.c_int64
+        lib.relora_fill_blocks_mapping.argtypes = list(blocks_args) + [ctypes.c_uint32, i64p]
+        lib.relora_fill_blocks_mapping.restype = None
         _LIB = lib
         return _LIB
 
@@ -127,33 +133,62 @@ def build_bert_mapping(
     max_seq_length: int,
     short_seq_prob: float,
     seed: int,
-    blocks: bool = False,
 ) -> Optional[np.ndarray]:
     """BERT-style span mapping (parity: helpers.cpp build_mapping :261-511).
     Rows are (first_sentence, end_sentence, target_len), shuffled
-    deterministically by seed.
-
-    ``blocks=True`` adds the owning document index as column 3 —
-    (first_sentence, end_sentence, doc, target_len).  This serves the same
-    purpose as the reference's build_blocks_mapping (:513-747) but is NOT
-    bit-identical to it: the reference's block variant uses fixed per-doc
-    targets net of title sizes and records a block id; ours reuses the
-    short-seq sampling walk.  No training path consumes either."""
+    deterministically by seed."""
     lib = load()
     if lib is None:
         return None
     docs = np.ascontiguousarray(docs, dtype=np.int64)
     sizes = np.ascontiguousarray(sizes, dtype=np.int32)
     n_docs = len(docs) - 1
-    count_fn = lib.relora_count_block_mapping if blocks else lib.relora_count_bert_mapping
-    fill_fn = lib.relora_fill_block_mapping if blocks else lib.relora_fill_bert_mapping
     args = (docs, n_docs, sizes, num_epochs, max_num_samples, max_seq_length, short_seq_prob, seed)
-    n = count_fn(*args)
-    cols = 4 if blocks else 3
-    maps = np.zeros((n, cols), dtype=np.int64)
+    n = lib.relora_count_bert_mapping(*args)
+    maps = np.zeros((n, 3), dtype=np.int64)
     if n:
-        fill_fn(*args, maps.reshape(-1))
+        lib.relora_fill_bert_mapping(*args, maps.reshape(-1))
     return maps
+
+
+def build_blocks_mapping(
+    docs: np.ndarray,
+    sizes: np.ndarray,
+    titles_sizes: np.ndarray,
+    *,
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    seed: int,
+    use_one_sent_blocks: bool = False,
+) -> Optional[np.ndarray]:
+    """Block-span mapping, bit-identical to the reference's
+    build_blocks_mapping (helpers.cpp:513-747) — golden-tested against its
+    compiled module (tests/test_data_megatron.py).
+
+    Rows are (span_start_sentence, span_end_sentence, doc, block_id), where
+    the per-document target length is ``max_seq_length - titles_sizes[doc]``
+    and block_id is a per-epoch running id; rows come Fisher-Yates shuffled
+    with mt19937_64(seed + 1), exactly like the reference.  The output dtype
+    follows the reference's rule: uint32 when the sentence count fits, else
+    uint64."""
+    lib = load()
+    if lib is None:
+        return None
+    docs = np.ascontiguousarray(docs, dtype=np.int64)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    titles_sizes = np.ascontiguousarray(titles_sizes, dtype=np.int32)
+    n_docs = len(docs) - 1
+    args = (
+        docs, n_docs, sizes, titles_sizes, num_epochs, max_num_samples,
+        max_seq_length, int(use_one_sent_blocks),
+    )
+    n = lib.relora_count_blocks_mapping(*args)
+    maps = np.zeros((n, 4), dtype=np.int64)
+    if n:
+        lib.relora_fill_blocks_mapping(*args, seed, maps.reshape(-1))
+    out_dtype = np.uint32 if len(sizes) <= np.iinfo(np.uint32).max else np.uint64
+    return maps.astype(out_dtype)
 
 
 def build_blending_indices_native(

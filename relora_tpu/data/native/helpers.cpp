@@ -257,28 +257,105 @@ void relora_fill_bert_mapping(const int64_t* docs, int64_t n_docs,
   shuffle_rows<3>(maps, n, seed);
 }
 
-int64_t relora_count_block_mapping(const int64_t* docs, int64_t n_docs,
-                                   const int32_t* sizes, int32_t num_epochs,
-                                   int64_t max_num_samples, int32_t max_seq_length,
-                                   double short_seq_prob, uint32_t seed) {
-  return walk_spans(docs, n_docs, sizes, num_epochs, max_num_samples,
-                    max_seq_length, short_seq_prob, seed,
-                    [](int64_t, int64_t, int64_t, int64_t, int32_t) {});
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Block-span mapping, bit-parity with the reference's build_blocks_mapping
+// (helpers.cpp:513-747).  Differences from the BERT walk above that matter
+// for exactness:
+//
+//   - per-document target length: max_seq_length - titles_sizes[doc]
+//     (each block leaves room for its document's title); NO short-seq
+//     randomness — the walk is fully deterministic
+//   - rows are (span_start, span_end, doc, block_id), where block_id is a
+//     per-epoch running counter over emitted blocks (used downstream to
+//     build block indexes), not the target length
+//   - min_num_sent is 2, or 1 under use_one_sent_blocks, and gates both the
+//     doc-skip and the "enough sentences left" emission condition
+//   - the max_num_samples budget is only checked at epoch boundaries: a
+//     started epoch always completes
+//
+// The final Fisher-Yates shuffle matches the reference exactly:
+// mt19937_64(seed + 1) with j = rng() % (i + 1)  (shuffle_rows above).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+template <typename Emit>
+int64_t walk_blocks(const int64_t* docs, int64_t n_docs, const int32_t* sizes,
+                    const int32_t* titles_sizes, int32_t num_epochs,
+                    int64_t max_num_samples, int32_t max_seq_length,
+                    int32_t min_num_sent, Emit emit) {
+  int64_t emitted = 0;
+  for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+    if (emitted >= max_num_samples) break;
+    int64_t block_id = 0;
+    for (int64_t doc = 0; doc < n_docs; ++doc) {
+      const int64_t first = docs[doc];
+      const int64_t last = docs[doc + 1];
+      const int32_t target = max_seq_length - titles_sizes[doc];
+      int64_t remaining = last - first;
+      if (remaining < min_num_sent) continue;
+      bool has_long = false;
+      for (int64_t s = first; s < last; ++s) {
+        if (sizes[s] > kLongSentenceLen) { has_long = true; break; }
+      }
+      if (has_long) continue;
+
+      int64_t span_start = first;
+      int32_t seq_len = 0;
+      int32_t num_sent = 0;
+      for (int64_t s = first; s < last; ++s) {
+        seq_len += sizes[s];
+        ++num_sent;
+        --remaining;
+        const bool full =
+            seq_len >= target && remaining >= min_num_sent && num_sent >= min_num_sent;
+        if (full || remaining == 0) {
+          emit(emitted, span_start, s + 1, doc, block_id);
+          ++emitted;
+          ++block_id;
+          span_start = s + 1;
+          seq_len = 0;
+          num_sent = 0;
+        }
+      }
+    }
+  }
+  return emitted;
 }
 
-void relora_fill_block_mapping(const int64_t* docs, int64_t n_docs,
-                               const int32_t* sizes, int32_t num_epochs,
-                               int64_t max_num_samples, int32_t max_seq_length,
-                               double short_seq_prob, uint32_t seed,
-                               int64_t* maps) {
-  const int64_t n = walk_spans(
-      docs, n_docs, sizes, num_epochs, max_num_samples, max_seq_length,
-      short_seq_prob, seed,
-      [maps](int64_t i, int64_t start, int64_t end, int64_t doc, int32_t target) {
+}  // namespace
+
+extern "C" {
+
+int64_t relora_count_blocks_mapping(const int64_t* docs, int64_t n_docs,
+                                    const int32_t* sizes,
+                                    const int32_t* titles_sizes,
+                                    int32_t num_epochs, int64_t max_num_samples,
+                                    int32_t max_seq_length,
+                                    int32_t use_one_sent_blocks) {
+  const int32_t min_sent = use_one_sent_blocks ? 1 : 2;
+  return walk_blocks(docs, n_docs, sizes, titles_sizes, num_epochs,
+                     max_num_samples, max_seq_length, min_sent,
+                     [](int64_t, int64_t, int64_t, int64_t, int64_t) {});
+}
+
+void relora_fill_blocks_mapping(const int64_t* docs, int64_t n_docs,
+                                const int32_t* sizes,
+                                const int32_t* titles_sizes, int32_t num_epochs,
+                                int64_t max_num_samples, int32_t max_seq_length,
+                                int32_t use_one_sent_blocks, uint32_t seed,
+                                int64_t* maps) {
+  const int32_t min_sent = use_one_sent_blocks ? 1 : 2;
+  const int64_t n = walk_blocks(
+      docs, n_docs, sizes, titles_sizes, num_epochs, max_num_samples,
+      max_seq_length, min_sent,
+      [maps](int64_t i, int64_t start, int64_t end, int64_t doc, int64_t block_id) {
         maps[4 * i] = start;
         maps[4 * i + 1] = end;
         maps[4 * i + 2] = doc;
-        maps[4 * i + 3] = target;
+        maps[4 * i + 3] = block_id;
       });
   shuffle_rows<4>(maps, n, seed);
 }

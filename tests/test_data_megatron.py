@@ -251,10 +251,42 @@ def test_bert_mapping_builders():
     maps3 = build_bert_mapping(docs, sizes, **{**kw, "seed": 8})
     assert not np.array_equal(maps, maps3)
 
-    blocks = build_bert_mapping(docs, sizes, blocks=True, **kw)
+    from relora_tpu.data.native import build_blocks_mapping
+
+    titles = rs.randint(0, 10, size=20).astype(np.int32)
+    blocks = build_blocks_mapping(
+        docs, sizes, titles, num_epochs=2, max_num_samples=1000,
+        max_seq_length=128, seed=7,
+    )
     assert blocks.shape[1] == 4
-    for start, end, d, target in blocks[:50]:
+    for start, end, d, block_id in blocks[:50]:
         assert docs[d] <= start < end <= docs[d + 1]
+
+
+def test_blocks_mapping_bit_parity_goldens():
+    """Byte-identical to the reference's compiled build_blocks_mapping
+    (helpers.cpp:513-747) on stored goldens — regenerate with
+    tools/gen_blocks_goldens.py (requires /root/reference)."""
+    import glob
+    import os
+
+    from relora_tpu.data.native import build_blocks_mapping
+
+    golden_dir = os.path.join(os.path.dirname(__file__), "golden")
+    files = sorted(glob.glob(os.path.join(golden_dir, "blocks_mapping_*.npz")))
+    assert files, "golden files missing — run tools/gen_blocks_goldens.py"
+    for f in files:
+        g = np.load(f)
+        got = build_blocks_mapping(
+            g["docs"], g["sizes"], g["titles"],
+            num_epochs=int(g["num_epochs"]),
+            max_num_samples=int(g["max_num_samples"]),
+            max_seq_length=int(g["max_seq_length"]),
+            seed=int(g["seed"]),
+            use_one_sent_blocks=bool(g["use_one_sent_blocks"]),
+        )
+        assert got.dtype == g["expected"].dtype, f
+        np.testing.assert_array_equal(got, g["expected"], err_msg=f)
 
 
 def test_interleaved_host_slicing(tmp_path):

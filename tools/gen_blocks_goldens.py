@@ -1,0 +1,91 @@
+"""Generate bit-parity goldens for build_blocks_mapping from the REFERENCE's
+own compiled module.
+
+Compiles /root/reference/peft_pretraining/megatron_dataset/helpers.cpp (in a
+temp dir, against the pybind11 headers torch ships) and records its
+build_blocks_mapping outputs for a spread of configurations into
+tests/golden/blocks_mapping_*.npz.  The committed goldens let the test suite
+assert byte-identity without needing the reference or a compiler at test
+time.
+
+Usage: python tools/gen_blocks_goldens.py
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+import numpy as np
+
+REF_SRC = "/root/reference/peft_pretraining/megatron_dataset/helpers.cpp"
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "golden")
+
+
+def compile_reference(tmp: str):
+    import torch
+
+    torch_inc = os.path.join(os.path.dirname(torch.__file__), "include")
+    np_inc = np.get_include()
+    py_inc = sysconfig.get_paths()["include"]
+    # the reference's PYBIND11_MODULE name is "helpers" — the .so and the
+    # import name must match it
+    so = os.path.join(tmp, "helpers.so")
+    src = os.path.join(tmp, "helpers.cpp")
+    shutil.copy(REF_SRC, src)
+    subprocess.run(
+        [
+            "g++", "-O3", "-Wall", "-shared", "-std=c++11", "-fPIC", src, "-o", so,
+            f"-I{torch_inc}", f"-I{np_inc}", f"-I{py_inc}",
+        ],
+        check=True,
+    )
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("helpers", so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cases():
+    rs = np.random.RandomState(42)
+    # (name, n_docs, sent range, size range, epochs, max_samples, seq, seed, one_sent, titles)
+    yield "basic", 30, (2, 9), (5, 80), 2, 10_000, 128, 7, False, (0, 1)
+    yield "titles", 25, (1, 7), (5, 60), 3, 10_000, 96, 13, False, (0, 30)
+    yield "one_sent", 40, (1, 5), (5, 50), 2, 10_000, 64, 101, True, (0, 8)
+    yield "budget", 50, (3, 10), (10, 100), 5, 40, 256, 3, False, (0, 5)
+    yield "long_sent", 20, (2, 6), (400, 600), 2, 10_000, 1024, 9, False, (0, 2)
+
+
+def main() -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        ref = compile_reference(tmp)
+        rs = np.random.RandomState(0)
+        for name, n_docs, sents, szs, epochs, max_s, seq, seed, one_sent, trange in cases():
+            counts = rs.randint(*sents, size=n_docs)
+            docs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+            sizes = rs.randint(*szs, size=int(docs[-1])).astype(np.int32)
+            titles = rs.randint(*trange, size=n_docs).astype(np.int32)
+            expected = np.asarray(
+                ref.build_blocks_mapping(
+                    docs, sizes, titles, epochs, max_s, seq, seed, False, one_sent
+                )
+            )
+            out = os.path.join(OUT_DIR, f"blocks_mapping_{name}.npz")
+            np.savez_compressed(
+                out,
+                docs=docs, sizes=sizes, titles=titles,
+                num_epochs=epochs, max_num_samples=max_s, max_seq_length=seq,
+                seed=seed, use_one_sent_blocks=one_sent, expected=expected,
+            )
+            print(f"{name}: {expected.shape[0]} rows dtype={expected.dtype} -> {out}")
+
+
+if __name__ == "__main__":
+    main()
