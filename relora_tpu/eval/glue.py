@@ -121,6 +121,9 @@ class GlueConfig:
     seed: int = 0
     use_lora: bool = False
     lora_r: int = 8
+    # custom (non-GLUE) datasets: explicit label count; None = from the task
+    # table (parity: num_labels inference, run_glue.py:392-411)
+    num_labels: Optional[int] = None
 
 
 def classification_loss(logits: jax.Array, labels: jax.Array, num_labels: int) -> jax.Array:
@@ -140,15 +143,20 @@ def finetune(
     steps_per_epoch: int,
     pad_token_id: int = 0,
     pretrained_backbone=None,
-) -> Dict[str, float]:
-    """Fine-tune and return the task metrics.
+    predict_batches: Optional[Callable[[], Iterator[np.ndarray]]] = None,
+    do_train: bool = True,
+    do_eval: bool = True,
+):
+    """Fine-tune and return ``(metrics, predictions)``.
 
-    ``train_batches``/``eval_batches`` yield (input_ids, labels) numpy pairs.
+    ``train_batches``/``eval_batches`` yield (input_ids, labels) numpy pairs;
+    ``predict_batches`` (if given) yields unlabeled input_ids and produces
+    test-set predictions (parity: do_predict, run_glue.py:594-614).
     ``pretrained_backbone`` is a causal-LM param tree (ours) whose base
     weights are grafted under the classifier's ``model`` subtree — how a
     ReLoRA-pretrained checkpoint is evaluated downstream.
     """
-    num_labels = TASK_NUM_LABELS[gcfg.task]
+    num_labels = gcfg.num_labels or TASK_NUM_LABELS[gcfg.task]
     lora = LoraSpec(r=gcfg.lora_r, alpha=2 * gcfg.lora_r, dropout=0.1) if gcfg.use_lora else None
     model = LlamaForSequenceClassification(
         model_cfg,
@@ -190,25 +198,35 @@ def finetune(
 
     rng = jax.random.PRNGKey(gcfg.seed + 1)
     step = 0
-    for epoch in range(gcfg.num_epochs):
-        for ids, labels in train_batches():
-            params, opt_state, loss = train_step(
-                params, opt_state, jnp.asarray(ids), jnp.asarray(labels),
-                jax.random.fold_in(rng, step),
-            )
-            step += 1
-        logger.info(f"epoch {epoch}: last train loss {float(loss):.4f}")
+    if do_train:
+        for epoch in range(gcfg.num_epochs):
+            for ids, labels in train_batches():
+                params, opt_state, loss = train_step(
+                    params, opt_state, jnp.asarray(ids), jnp.asarray(labels),
+                    jax.random.fold_in(rng, step),
+                )
+                step += 1
+            logger.info(f"epoch {epoch}: last train loss {float(loss):.4f}")
 
-    preds, labels_all = [], []
-    for ids, labels in eval_batches():
-        logits = predict(params, jnp.asarray(ids))
+    def logits_to_preds(logits):
         if num_labels == 1:
-            preds.append(np.asarray(logits)[:, 0])
-        else:
-            preds.append(np.argmax(np.asarray(logits), axis=-1))
-        labels_all.append(labels)
-    preds = np.concatenate(preds)
-    labels_all = np.concatenate(labels_all)
-    metrics = task_metrics(gcfg.task, preds, labels_all)
-    logger.info(f"{gcfg.task}: {metrics}")
-    return metrics
+            return np.asarray(logits)[:, 0]
+        return np.argmax(np.asarray(logits), axis=-1)
+
+    metrics: Dict[str, float] = {}
+    if do_eval:
+        preds, labels_all = [], []
+        for ids, labels in eval_batches():
+            preds.append(logits_to_preds(predict(params, jnp.asarray(ids))))
+            labels_all.append(labels)
+        preds = np.concatenate(preds)
+        labels_all = np.concatenate(labels_all)
+        metrics = task_metrics(gcfg.task, preds, labels_all)
+        logger.info(f"{gcfg.task}: {metrics}")
+
+    predictions = None
+    if predict_batches is not None:
+        predictions = np.concatenate(
+            [logits_to_preds(predict(params, jnp.asarray(ids))) for ids in predict_batches()]
+        )
+    return metrics, predictions

@@ -62,6 +62,7 @@ def make_train_step(
     zigzag_ring: Optional[int] = None,
     loss_impl: str = "dense",  # dense | chunked (streamed vocab CE)
     vocab_chunk: int = 8192,
+    log_per_layer_scaling: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -184,19 +185,30 @@ def make_train_step(
 
             for key, sub in grads.items():
                 metrics[f"grad_norm/{key}"] = global_norm(sub)
-        # per-run mean of trainable scalings (parity: per-layer lora_scaling
+        # trainable-scaling observability (parity: per-layer lora_scaling
         # logging under --train_scaling, torchrun_main.py:937-942)
         scaling_leaves = [
-            leaf
+            (path, leaf)
             for path, leaf in jax.tree_util.tree_flatten_with_path(final_trainable)[0]
             if str(getattr(path[-1], "key", path[-1])) == "lora_s"
         ]
         if scaling_leaves:
             # mean of the *effective* scales (tanh applied per leaf, exactly
             # as the forward pass uses them)
-            metrics["lora_scaling"] = jnp.mean(
-                jnp.stack([jnp.tanh(l.astype(jnp.float32)).mean() for l in scaling_leaves])
-            )
+            effective = [jnp.tanh(l.astype(jnp.float32)) for _, l in scaling_leaves]
+            metrics["lora_scaling"] = jnp.mean(jnp.stack([e.mean() for e in effective]))
+            if log_per_layer_scaling:
+                for (path, _), eff in zip(scaling_leaves, effective):
+                    name = ".".join(
+                        str(getattr(k, "key", k)) for k in path[:-1]
+                    )
+                    if eff.ndim >= 1 and eff.shape[0] > 1:
+                        # scan-stacked: leading axis is the layer index
+                        per_layer = eff.reshape(eff.shape[0], -1).mean(axis=1)
+                        for i in range(eff.shape[0]):
+                            metrics[f"lora_scaling/{name}/layer{i}"] = per_layer[i]
+                    else:
+                        metrics[f"lora_scaling/{name}"] = eff.mean()
         return new_state, metrics
 
     return train_step

@@ -301,6 +301,7 @@ class Trainer:
                 zigzag_ring=zigzag_ring,
                 loss_impl=cfg.loss_impl,
                 vocab_chunk=cfg.vocab_chunk,
+                log_per_layer_scaling=cfg.train_scaling,
             ),
             donate_argnums=0,
         )

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# GLUE-harness end-to-end from a real pretrained checkpoint (VERDICT r1 #9):
+# two classification tasks built from local text, fine-tuned with run_glue.py
+# on a ReLoRA/full-rank checkpoint + its corpus tokenizer, metrics to
+# $WORK/<task>/all_results.json and predictions to predict_results_*.txt.
+#
+#   CHECKPOINT=/tmp/loss_parity/warmup/model_1000 \
+#   TOKENIZER=/tmp/corpus/local400.tokenizer.json \
+#   bash scripts/glue_e2e.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CHECKPOINT="${CHECKPOINT:?set CHECKPOINT=<model_N dir>}"
+TOKENIZER="${TOKENIZER:?set TOKENIZER=<tokenizer.json>}"
+MODEL_CONFIG="${MODEL_CONFIG:-llama_35m}"
+WORK="${WORK:-/tmp/glue_e2e}"
+SP="/opt/venv/lib/python3.12/site-packages"
+
+mkdir -p "$WORK"
+
+# task 1: code vs prose (binary)
+python tools/build_cls_dataset.py --out "$WORK/data_srctype" --per-label 400 \
+    --root "code=$SP/numpy,$SP/scipy@py" \
+    --root "prose=$SP@md,rst,txt"
+
+# task 2: which library does this code come from (3-way)
+python tools/build_cls_dataset.py --out "$WORK/data_pkgid" --per-label 300 \
+    --root "numpy=$SP/numpy@py" \
+    --root "jax=$SP/jax@py" \
+    --root "torch=$SP/torch@py"
+
+for task in srctype pkgid; do
+  rm -rf "$WORK/$task"
+  python run_glue.py --task_name "$task" \
+      --train_file "$WORK/data_$task/train.csv" \
+      --validation_file "$WORK/data_$task/dev.csv" \
+      --test_file "$WORK/data_$task/test.csv" --do_predict true \
+      --model_config "$MODEL_CONFIG" --checkpoint "$CHECKPOINT" \
+      --tokenizer "$TOKENIZER" \
+      --batch_size 16 --num_epochs "${EPOCHS:-2}" --max_seq_length 128 \
+      --lr 5e-5 --output_dir "$WORK/$task"
+done
+
+echo "=== results ==="
+cat "$WORK"/srctype/all_results.json "$WORK"/pkgid/all_results.json

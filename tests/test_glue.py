@@ -103,7 +103,7 @@ def test_finetune_learns_synthetic_task():
     lm_params = init_params(lm, jax.random.PRNGKey(5), jnp.zeros((1, 8), jnp.int32))
 
     gcfg = GlueConfig(task="sst2", lr=5e-3, batch_size=bs, num_epochs=4, seed=0)
-    metrics = finetune(
+    metrics, _ = finetune(
         TINY,
         gcfg,
         train_batches,
@@ -136,7 +136,7 @@ def test_finetune_with_lora():
 
     gcfg = GlueConfig(task="sst2", lr=8e-3, batch_size=bs, num_epochs=4,
                       use_lora=True, lora_r=4, seed=1)
-    metrics = finetune(TINY, gcfg, batches, batches, steps, pad_token_id=0)
+    metrics, _ = finetune(TINY, gcfg, batches, batches, steps, pad_token_id=0)
     assert metrics["accuracy"] > 0.8
 
 
@@ -155,7 +155,7 @@ def test_finetune_regression_stsb_path():
             yield ids[i * bs:(i + 1) * bs], labels[i * bs:(i + 1) * bs]
 
     gcfg = GlueConfig(task="stsb", lr=1e-2, batch_size=bs, num_epochs=8, seed=2)
-    metrics = finetune(TINY, gcfg, batches, batches, steps, pad_token_id=0)
+    metrics, _ = finetune(TINY, gcfg, batches, batches, steps, pad_token_id=0)
     # the 2-layer toy model learns the signal only partially; the point is
     # exercising the MSE/regression path end-to-end
     assert metrics["pearson"] > 0.5 and metrics["spearmanr"] > 0.5

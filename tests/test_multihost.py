@@ -101,3 +101,148 @@ def test_two_process_data_parallel_training(tmp_path):
     assert all(r["result"]["update_step"] == 6 for r in results)
     assert results[0]["probe"] == pytest.approx(results[1]["probe"], rel=1e-6)
     assert np.isfinite(results[0]["probe"])
+
+
+# ---------------------------------------------------------------------------
+# 2-process x 2-local-device (fsdp=2 x data=2 mesh) ReLoRA over the megatron
+# per-host data path, killed mid-run and autoresumed — the places multi-host
+# bugs actually live: sharded params + merge under fsdp, coordinator-built
+# index mappings with a cross-process barrier, per-host batch slicing,
+# deterministic data rewind, and the commit-aware autoresume probe after a
+# SIGKILL that may interrupt an async checkpoint write.  Multiple local
+# devices per process mirrors real TPU-pod topology (4 chips/host); it also
+# keeps cross-process compile skew inside gloo's 30s context-init deadline,
+# which a 4-singleton-process layout exceeds on a contended CPU host.
+# The continuity oracle: the resumed run's per-step losses must reproduce
+# the killed run's exactly (same data order, restored optimizer/schedule
+# state, same compiled program).
+# ---------------------------------------------------------------------------
+
+WORKER4 = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+coordinator, pid, workdir, steps = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
+jax.distributed.initialize(coordinator_address=coordinator, num_processes=2, process_id=pid)
+assert jax.process_count() == 2 and len(jax.devices()) == 4
+
+sys.path.insert(0, "/root/repo")
+import main as cli
+
+cli.main([
+    "--megatron_dataset_config", f"{workdir}/mega.yaml",
+    "--model_config", f"{workdir}/model.json",
+    "--batch_size", "2", "--total_batch_size", "8", "--max_length", "16",
+    "--dp_size", "2", "--fsdp_size", "2",
+    "--lr", "5e-3", "--use_peft", "true", "--lora_r", "4",
+    "--relora", "5", "--cycle_length", "5",
+    "--scheduler", "cosine_restarts", "--warmup_steps", "2",
+    "--restart_warmup_steps", "2",
+    "--num_training_steps", steps, "--save_every", "5",
+    "--eval_every", "1000", "--seed", "0",
+    "--save_dir", f"{workdir}/run", "--autoresume", "true",
+])
+"""
+
+
+def _read_losses(metrics_path):
+    losses = {}
+    if not os.path.exists(metrics_path):
+        return losses
+    with open(metrics_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # half-written line at kill time
+            if "loss" in rec and "update_step" in rec:
+                losses[rec["update_step"]] = rec["loss"]
+    return losses
+
+
+def _spawn4(tmp_path, worker_file, coordinator, steps):
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    return [
+        subprocess.Popen(
+            [sys.executable, str(worker_file), coordinator, str(pid), str(tmp_path), steps],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+
+
+@pytest.mark.slow
+def test_four_process_fsdp_megatron_kill_autoresume(tmp_path):
+    import time
+
+    from relora_tpu.data.memmap import MemmapTokenWriter, best_dtype
+
+    # shared mmap corpus (structured so loss is comparable across runs)
+    rs = np.random.RandomState(0)
+    with MemmapTokenWriter(str(tmp_path / "corpus"), dtype=best_dtype(128)) as w:
+        for _ in range(2000):
+            start = rs.randint(128)
+            w.add_document([(start + j) % 128 for j in range(rs.randint(10, 60))])
+    (tmp_path / "mega.yaml").write_text(
+        f"data_path: {tmp_path}/corpus\nsplit: '10,0,0'\nseq_length: 16\nseed: 0\ndata_impl: mmap\n"
+    )
+    from tests.test_end_to_end import TINY
+
+    (tmp_path / "model.json").write_text(json.dumps(TINY.to_dict()))
+    worker_file = tmp_path / "worker4.py"
+    worker_file.write_text(WORKER4)
+    metrics = tmp_path / "run" / "metrics.jsonl"
+
+    # phase A: long run; kill all 4 once a checkpoint committed and step >= 7
+    procs = _spawn4(tmp_path, worker_file, f"127.0.0.1:{_free_port()}", "20")
+    deadline = time.time() + 900
+    try:
+        while time.time() < deadline:
+            committed = os.path.isdir(tmp_path / "run" / "model_5" / "state")
+            if committed and max(_read_losses(metrics), default=0) >= 7:
+                break
+            if any(p.poll() is not None for p in procs):
+                errs = "\n".join((p.communicate()[1] or "")[-2000:] for p in procs if p.poll() is not None)
+                pytest.fail(f"phase A worker exited early:\n{errs}")
+            time.sleep(1.0)
+        else:
+            pytest.fail("phase A never reached step 7 with a committed checkpoint")
+    finally:
+        for p in procs:
+            p.kill()
+    for p in procs:
+        p.communicate()
+
+    losses_a = _read_losses(metrics)
+    assert losses_a and max(losses_a) >= 7
+
+    # phase B: autoresume with the SAME step budget (the schedule envelope is
+    # a function of num_training_steps; changing it would change lr and break
+    # the continuity oracle) — must pick up model_5 and rewind data
+    procs = _spawn4(tmp_path, worker_file, f"127.0.0.1:{_free_port()}", "20")
+    for p in procs:
+        try:
+            _, stderr = p.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("phase B timed out")
+        assert p.returncode == 0, f"phase B worker failed:\n{stderr[-3000:]}"
+
+    losses_b = _read_losses(metrics)
+    # resumed losses reproduce the killed run bit-for-bit on overlapping steps
+    overlap = [s for s in range(6, 21) if s in losses_a and s in losses_b and losses_b[s] is not None]
+    assert overlap, f"no overlapping steps: A={sorted(losses_a)}, B={sorted(losses_b)}"
+    for s in overlap:
+        assert losses_b[s] == pytest.approx(losses_a[s], rel=1e-6), (
+            f"loss diverged at resumed step {s}: {losses_a[s]} vs {losses_b[s]}"
+        )
+    # the run completed and a final checkpoint exists
+    assert os.path.isdir(tmp_path / "run" / "model_20" / "state")

@@ -109,6 +109,11 @@ def test_trainable_scaling_end_to_end(tmp_path):
     lines = [json.loads(l) for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
     scal = [l["lora_scaling"] for l in lines if "lora_scaling" in l]
     assert scal and all(-1.0 <= s <= 1.0 for s in scal)
+    # per-layer logging under train_scaling (torchrun_main.py:937-942 parity):
+    # scan-stacked modules expand to one entry per layer
+    per_layer = [k for k in lines[-2] if k.startswith("lora_scaling/")]
+    assert any("layer0" in k and "q_proj" in k for k in per_layer), per_layer
+    assert any("layer1" in k for k in per_layer)
     # merge at step 9 zeroed the scalings
     s_leaf = np.asarray(trainer.state.params["layers"]["self_attn"]["q_proj"]["lora_s"])
     # one step of training after the merge may have nudged it slightly

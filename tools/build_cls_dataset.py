@@ -1,0 +1,95 @@
+"""Build a csv text-classification dataset from labeled local directory
+roots — GLUE-style custom-file tasks for air-gapped environments.
+
+Each ``--root LABEL=PATH[,PATH...][@EXT,EXT...]`` contributes snippets
+labeled LABEL; snippets are fixed-length character windows sampled from
+matching files under the roots (default extensions: py,md,rst,txt).
+Output: ``<out>/train.csv``, ``dev.csv``, ``test.csv`` with columns
+(sentence, label) — consumable by run_glue.py --train_file.
+
+Usage::
+
+    python tools/build_cls_dataset.py --out /tmp/glue_pysrc \
+        --root "code=/opt/venv/lib/python3.12/site-packages/numpy@py" \
+        --root "prose=/opt/venv/lib/python3.12/site-packages@md,rst,txt" \
+        --per-label 600
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import random
+
+
+def snippets_from(paths, n, rng, width=400, exts=(".py", ".md", ".rst", ".txt")):
+    files = []
+    for root in paths:
+        for dirpath, dirnames, names in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files.extend(
+                os.path.join(dirpath, f) for f in names if f.endswith(tuple(exts))
+            )
+    rng.shuffle(files)
+    out = []
+    for path in files:
+        if len(out) >= n:
+            break
+        try:
+            with open(path, encoding="utf-8", errors="strict") as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        if len(text) < width:
+            continue
+        for _ in range(min(3, 1 + len(text) // (4 * width))):
+            if len(out) >= n:
+                break
+            start = rng.randrange(0, len(text) - width)
+            snippet = " ".join(text[start : start + width].split())
+            if snippet:
+                out.append(snippet)
+    if len(out) < n:
+        raise SystemExit(f"only {len(out)} snippets found (wanted {n})")
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--root", action="append", required=True,
+                    help="LABEL=PATH[,PATH...] (repeatable)")
+    ap.add_argument("--per-label", type=int, default=600)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    rows = []
+    for spec in args.root:
+        label, rest = spec.split("=", 1)
+        paths, _, extspec = rest.partition("@")
+        exts = tuple(
+            e if e.startswith(".") else f".{e}" for e in extspec.split(",")
+        ) if extspec else (".py", ".md", ".rst", ".txt")
+        for s in snippets_from(paths.split(","), args.per_label, rng, exts=exts):
+            rows.append({"sentence": s, "label": label})
+    rng.shuffle(rows)
+
+    os.makedirs(args.out, exist_ok=True)
+    n = len(rows)
+    splits = {
+        "train.csv": rows[: int(n * 0.8)],
+        "dev.csv": rows[int(n * 0.8) : int(n * 0.9)],
+        "test.csv": rows[int(n * 0.9) :],
+    }
+    for name, split in splits.items():
+        with open(os.path.join(args.out, name), "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=["sentence", "label"])
+            w.writeheader()
+            w.writerows(split)
+        print(f"{name}: {len(split)} rows")
+
+
+if __name__ == "__main__":
+    main()
