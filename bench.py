@@ -1,9 +1,9 @@
 """Headline benchmark: ReLoRA training throughput on one TPU chip.
 
-Config mirrors BASELINE.md benchmark 3 scaled to a single chip: llama_1b,
-LoRA r=128 (the production 1B recipe's rank), seq 1024, bf16 compute,
-remat-over-scanned-layers, scan grad-accum train step.  Prints ONE JSON
-line::
+Default config mirrors BASELINE.md benchmark 3 scaled to a single chip:
+llama_1b, LoRA r=128 (the production 1B recipe's rank), seq 1024, bf16
+compute, remat-over-scanned-layers, scan grad-accum train step.  Prints ONE
+JSON line::
 
     {"metric": "...", "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
 
@@ -13,6 +13,10 @@ throughput numbers (BASELINE.md), so the committed target is the north-star
 (Note: the sandbox's remote-compile tunnel rejects programs above a size
 threshold, which caps microbatch at 8 here; MFU counts only the 6N model
 FLOPs, so remat recompute deflates it.)
+
+Other BASELINE.md benchmark configs are selectable by env var, e.g.
+``BENCH_CONFIG=llama_250m python bench.py``.  The measurement loop itself
+lives in relora_tpu.utils.benchlib (shared with scripts/bench_sweep.py).
 """
 
 from __future__ import annotations
@@ -21,10 +25,6 @@ import json
 import os
 import sys
 import threading
-import time
-
-import jax
-import jax.numpy as jnp
 
 # Watchdog: if the TPU tunnel wedges (observed in this sandbox), emit a
 # diagnostic line instead of hanging forever.  A daemon thread (not SIGALRM):
@@ -48,80 +48,44 @@ def _watchdog():
     sys.stdout.flush()
     os._exit(2)
 
-MODEL = "llama_1b"
-MICRO_BATCH = 8
-GRAD_ACCUM = 1
-SEQ = 1024
-REMAT = True
-WARMUP_STEPS = 3
-MEASURE_STEPS = 10
 
-# bf16 peak of one TPU v5e (v5 lite) chip
-PEAK_FLOPS = 197e12
+# Named benchmark configs (BASELINE.md's benchmark list).  "magnitude"
+# proves the pruning-reset path on-chip (run once between warmup and the
+# timed window) and reports the post-reset steady-state throughput; the 1B
+# recipe amortizes the reset over 1000 steps, so it is deliberately
+# excluded from the per-step figure.
+BENCH_CONFIGS = {
+    "llama_1b": dict(model_name="llama_1b", micro_batch=8, grad_accum=1, seq=1024),
+    "llama_250m": dict(model_name="llama_250m", micro_batch=24, grad_accum=1, seq=512),
+    "llama_1b_magnitude": dict(
+        model_name="llama_1b", micro_batch=8, grad_accum=1, seq=1024, magnitude_reset=True
+    ),
+}
+_CFG_NAME = os.environ.get("BENCH_CONFIG", "llama_1b")
+if _CFG_NAME not in BENCH_CONFIGS:
+    sys.exit(f"Unknown BENCH_CONFIG={_CFG_NAME!r}; choose from {sorted(BENCH_CONFIGS)}")
+_CFG = BENCH_CONFIGS[_CFG_NAME]
 
 
 def main() -> None:
-    from relora_tpu.config.model import MODEL_ZOO
-    from relora_tpu.core.optim import build_optimizer
-    from relora_tpu.core.partition import partition
-    from relora_tpu.core.relora import LoraSpec, trainable_param_mask
-    from relora_tpu.models.llama import LlamaForCausalLM
-    from relora_tpu.models.params_util import init_params
-    from relora_tpu.train.state import TrainState
-    from relora_tpu.train.step import make_train_step
+    from relora_tpu.utils.benchlib import run_throughput_bench
 
-    cfg = MODEL_ZOO[MODEL]
-    spec = LoraSpec(r=128, alpha=32, dropout=0.1)
-    model = LlamaForCausalLM(
-        cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True, remat=REMAT
-    )
-    sample = jnp.zeros((1, 8), jnp.int32)
-    params = init_params(model, jax.random.PRNGKey(0), sample)
-    mask = trainable_param_mask(params)
-    tx = build_optimizer(schedule=lambda s: 1e-3)
-    opt_state = jax.jit(tx.init)(partition(params, mask)[0])
-    state = TrainState.create(params, opt_state)
-    step = jax.jit(make_train_step(model, tx, mask), donate_argnums=0)
-
-    batch = jax.random.randint(
-        jax.random.PRNGKey(1), (GRAD_ACCUM, MICRO_BATCH, SEQ), 0, cfg.vocab_size
-    )
-    rng = jax.random.PRNGKey(2)
-
-    for i in range(WARMUP_STEPS):
-        state, metrics = step(state, batch, jax.random.fold_in(rng, i))
-    float(metrics["loss"])  # full sync (block_until_ready can return early
-    # through the axon relay; a scalar pull cannot)
-
-    t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        state, metrics = step(state, batch, jax.random.fold_in(rng, 100 + i))
-    # the final loss depends on every preceding step's params, so this one
-    # sync forces the whole chain to have executed
-    final_loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens_per_update = GRAD_ACCUM * MICRO_BATCH * SEQ
-    tokens_per_sec = tokens_per_update * MEASURE_STEPS / dt
-
-    # 6*N per token fwd+bwd on the dense (equivalent) params
-    n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
-    flops_per_token = 6 * n_params
-    mfu = tokens_per_sec * flops_per_token / PEAK_FLOPS
-
+    res = run_throughput_bench(remat=True, rank=128, **_CFG)
     print(
         json.dumps(
             {
-                "metric": f"{MODEL} ReLoRA r=128 seq{SEQ} bf16 training throughput",
-                "value": round(tokens_per_sec, 1),
+                "metric": f"{_CFG_NAME} ReLoRA r=128 seq{_CFG['seq']} bf16 "
+                "training throughput",
+                "value": res["tokens_per_sec"],
                 "unit": "tokens/sec/chip",
-                "vs_baseline": round(mfu / 0.5, 4),
+                "vs_baseline": round(res["mfu"] / 0.5, 4),
                 "detail": {
-                    "mfu": round(mfu, 4),
-                    "step_time_s": round(dt / MEASURE_STEPS, 4),
-                    "tokens_per_update": tokens_per_update,
-                    "loss": final_loss,
-                    "device": str(jax.devices()[0]),
+                    "mfu": res["mfu"],
+                    "step_time_s": res["step_time_s"],
+                    "tokens_per_update": res["tokens_per_update"],
+                    "loss": res["loss"],
+                    "device": res["device"],
+                    "config": _CFG_NAME,
                 },
             }
         )

@@ -64,9 +64,18 @@ class MegatronDataConfig:
 
 
 def parse_split_string(split: str, n: int) -> List[range]:
-    """'969,30,1' -> three contiguous document ranges covering [0, n)
-    (parity: data_utils.get_train_valid_test_split_ :163-187)."""
-    parts = [float(s) for s in str(split).split(",")]
+    """'969,30,1' (or '969/30/1') -> three contiguous document ranges
+    covering [0, n) (bit-parity: data_utils.get_train_valid_test_split_
+    :163-187).
+
+    The rounding correction matters: the reference subtracts the cumulative
+    rounding excess from *every* bound, not just the last — clamping only
+    the tail can produce a zero-width middle split at small n (e.g.
+    '1,1,1' over 10 docs is [0,4,7,10] here, not [0,3,6,10]).
+    """
+    s = str(split)
+    sep = "," if "," in s else ("/" if "/" in s else None)
+    parts = [float(x) for x in s.split(sep)] if sep else [float(s)]
     while len(parts) < 3:
         parts.append(0.0)
     parts = parts[:3]
@@ -76,8 +85,18 @@ def parse_split_string(split: str, n: int) -> List[range]:
     fracs = [p / total for p in parts]
     bounds = [0]
     for f in fracs:
-        bounds.append(bounds[-1] + int(round(f * n)))
-    bounds[-1] = n
+        bounds.append(bounds[-1] + int(round(f * float(n))))
+    diff = bounds[-1] - n
+    bounds = [bounds[0]] + [b - diff for b in bounds[1:]]
+    if any(b < 0 for b in bounds) or any(
+        bounds[i] > bounds[i + 1] for i in range(3)
+    ):
+        # degenerate splits (e.g. '0,1,1' over 3 docs) make the uniform
+        # correction go negative; the reference silently emits the same
+        # bounds and then wraps to wrong documents — fail loudly instead
+        raise ValueError(
+            f"split {split!r} over {n} documents produces invalid bounds {bounds}"
+        )
     return [range(bounds[i], bounds[i + 1]) for i in range(3)]
 
 

@@ -52,14 +52,43 @@ def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
 
 
 def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
-    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
 
+    S, S_kv = q.shape[1], k.shape[1]
+    if S < 128 or S_kv < 128 or S % 128 or S_kv % 128:
+        # shorter than one tile (e.g. the (1, 8) param-init trace) or
+        # non-tile-aligned: the flash tiling can't apply; XLA's fused path
+        # is fine at these sizes
+        return jax.nn.dot_product_attention(
+            q, k, v, scale=scale, is_causal=causal
+        )
     # the pallas kernel wants (batch, heads, seq, head_dim) with equal head
     # counts — grouped K/V are expanded here (the GQA HBM win still applies
     # to the projections/ring paths; this materialization is per-call)
     k, v = _expand_grouped_kv(q, k, v)
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=scale)
+    # largest tile that divides both lengths (the kernel's _verify_block
+    # requires exact divisibility, e.g. S=768 with blk=512 is rejected)
+    blk = next(b for b in (512, 256, 128) if S % b == 0 and S_kv % b == 0)
+    sizes = BlockSizes(
+        block_q=blk,
+        block_k_major=blk,
+        block_k=blk,
+        block_b=1,
+        block_q_major_dkv=blk,
+        block_k_major_dkv=blk,
+        block_k_dkv=blk,
+        block_q_dkv=blk,
+        block_k_major_dq=blk,
+        block_k_dq=blk,
+        block_q_dq=blk,
+    )
+    out = flash_attention(
+        qt, kt, vt, causal=causal, sm_scale=scale, block_sizes=sizes
+    )
     return out.swapaxes(1, 2)
 
 

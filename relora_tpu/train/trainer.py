@@ -657,20 +657,54 @@ class Trainer:
         return result
 
     # ------------------------------------------------------------------
-    def evaluate(self, eval_iter: Iterator[np.ndarray], target_tokens: int = -1):
+    def evaluate(
+        self,
+        eval_iter: Iterator[np.ndarray],
+        target_tokens: int = -1,
+        sync_every: int = 8,
+    ):
         """Token-weighted mean eval loss (parity: evaluate_model,
         torchrun_main.py:143-189; target 10M during training, 100M final,
-        -1 = full set)."""
+        -1 = full set).
+
+        Loss/token sums accumulate on-device and are pulled to the host only
+        every ``sync_every`` batches (and once at the end) — the reference's
+        per-batch ``.item()`` round trip is the kind of host sync the train
+        loop carefully lags, and through the sandbox's device tunnel it
+        dominates eval wall time.  The token-target check therefore fires at
+        drain points, overshooting by at most ``sync_every - 1`` batches
+        (the reference itself overshoots by up to one batch).
+        """
+        pending: list = []  # device-side partial sums, drained in one pull
         loss_sum = 0.0
         n_tokens = 0.0
-        for arr in eval_iter:
-            out = self._eval_step(self.state.params, self.device_batch(arr))
-            loss_sum += float(out["loss_sum"])
-            n_tokens += float(out["n_tokens"])
-            if jnp.isnan(jnp.asarray(loss_sum)):
+
+        def drain():
+            nonlocal loss_sum, n_tokens
+            if not pending:
+                return
+            # one stacked pull = one blocking device round trip per drain
+            sums = np.asarray(
+                jnp.stack(
+                    [
+                        jnp.sum(jnp.stack([p[k] for p in pending]))
+                        for k in ("loss_sum", "n_tokens")
+                    ]
+                )
+            )
+            loss_sum += float(sums[0])
+            n_tokens += float(sums[1])
+            pending.clear()
+            if np.isnan(loss_sum):
                 raise RuntimeError("NaN in evaluation loss")
-            if target_tokens > 0 and n_tokens >= target_tokens:
-                break
+
+        for arr in eval_iter:
+            pending.append(self._eval_step(self.state.params, self.device_batch(arr)))
+            if len(pending) >= max(sync_every, 1):
+                drain()
+                if target_tokens > 0 and n_tokens >= target_tokens:
+                    break
+        drain()
         return loss_sum / max(n_tokens, 1.0), n_tokens
 
     # ------------------------------------------------------------------

@@ -1,0 +1,122 @@
+"""Shared throughput-measurement core for bench.py and scripts/bench_sweep.py.
+
+One implementation of the model/optimizer construction, warmup, sync, and
+timed loop, so the headline bench and the lever-sweep harness cannot drift.
+Throughput definition parity: tokens_in_update / update_time
+(torchrun_main.py:928-931).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+# bf16 peak of one TPU v5e (v5 lite) chip
+PEAK_FLOPS_V5E = 197e12
+
+
+def run_throughput_bench(
+    model_name: str,
+    *,
+    micro_batch: int = 8,
+    grad_accum: int = 1,
+    seq: int = 1024,
+    remat: bool = True,
+    loss_impl: str = "dense",
+    vocab_chunk: int = 8192,
+    logits_dtype: str = "f32",
+    attn: str = "auto",
+    rank: Optional[int] = 128,
+    dropout: float = 0.1,
+    warmup_steps: int = 3,
+    measure_steps: int = 10,
+    magnitude_reset: bool = False,
+    peak_flops: float = PEAK_FLOPS_V5E,
+) -> dict:
+    """Build the ReLoRA train step for ``model_name`` and measure steady-state
+    training throughput on the default backend.  Returns a dict with
+    tokens_per_sec / mfu / step_time_s / loss / device.
+
+    ``rank=None`` (or 0) benches the full-rank configuration (every param
+    trainable).  ``magnitude_reset=True`` runs one magnitude-pruning
+    optimizer reset between warmup and the timed window (proves the path
+    on-chip; the 1B recipe amortizes its cost over 1000 steps, so it is
+    deliberately excluded from the per-step figure).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from relora_tpu.config.model import MODEL_ZOO
+    from relora_tpu.core.optim import build_optimizer
+    from relora_tpu.core.partition import partition
+    from relora_tpu.core.relora import LoraSpec, trainable_param_mask
+    from relora_tpu.models.llama import LlamaForCausalLM
+    from relora_tpu.models.params_util import init_params
+    from relora_tpu.train.state import TrainState
+    from relora_tpu.train.step import make_train_step
+
+    cfg = MODEL_ZOO[model_name]
+    spec = LoraSpec(r=rank, alpha=32, dropout=dropout) if rank else None
+    model = LlamaForCausalLM(
+        cfg,
+        lora=spec,
+        dtype=jnp.bfloat16,
+        scan_layers=True,
+        remat=remat,
+        attention_impl=attn,
+        logits_dtype=jnp.bfloat16 if logits_dtype == "bf16" else jnp.float32,
+    )
+    sample = jnp.zeros((1, 8), jnp.int32)
+    params = init_params(model, jax.random.PRNGKey(0), sample)
+    mask = trainable_param_mask(params)
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    opt_state = jax.jit(tx.init)(partition(params, mask)[0])
+    state = TrainState.create(params, opt_state)
+    step = jax.jit(
+        make_train_step(model, tx, mask, loss_impl=loss_impl, vocab_chunk=vocab_chunk),
+        donate_argnums=0,
+    )
+
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (grad_accum, micro_batch, seq), 0, cfg.vocab_size
+    )
+    rng = jax.random.PRNGKey(2)
+
+    for i in range(warmup_steps):
+        state, metrics = step(state, batch, jax.random.fold_in(rng, i))
+    if magnitude_reset:
+        from relora_tpu.core.optim import reset_optimizer_state
+
+        reset = jax.jit(
+            lambda s: s.replace(
+                opt_state=reset_optimizer_state(s.opt_state, mode="magnitude", ratio=0.9)
+            ),
+            donate_argnums=0,
+        )
+        state = reset(state)
+        # fence the reset's device execution out of the timed window
+        jax.block_until_ready(state.opt_state)
+    float(metrics["loss"])  # full sync (block_until_ready can return early
+    # through the axon relay; a scalar pull cannot)
+
+    t0 = time.perf_counter()
+    for i in range(measure_steps):
+        state, metrics = step(state, batch, jax.random.fold_in(rng, 100 + i))
+    # the final loss depends on every preceding step's params, so this one
+    # sync forces the whole chain to have executed
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_update = grad_accum * micro_batch * seq
+    tokens_per_sec = tokens_per_update * measure_steps / dt
+    # 6*N per token fwd+bwd on the dense (equivalent) params
+    n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
+    mfu = tokens_per_sec * 6 * n_params / peak_flops
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 4),
+        "step_time_s": round(dt / measure_steps, 4),
+        "tokens_per_update": tokens_per_update,
+        "loss": final_loss,
+        "device": str(jax.devices()[0]),
+    }

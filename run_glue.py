@@ -115,7 +115,9 @@ def main(argv=None):
 
     model_cfg = load_model_config(args.model_config)
     tokenizer = load_tokenizer(args.tokenizer)
-    is_custom = args.train_file is not None or args.validation_file is not None
+    is_custom = any(
+        f is not None for f in (args.train_file, args.validation_file, args.test_file)
+    )
     is_regression = args.task_name == "stsb"
 
     # ---- load splits ------------------------------------------------------
@@ -155,7 +157,22 @@ def main(argv=None):
     if is_regression:
         num_labels, label2id, id2label = 1, None, None
     elif is_custom:
-        label_list = sorted({str(r["label"]) for r in raw.get("train", raw[next(iter(raw))])})
+        # infer the label set from a split that actually carries labels
+        # (predict-only runs may load just an unlabeled test file)
+        labeled = next(
+            (
+                raw[name]
+                for name in ("train", "validation", "test")
+                if raw.get(name) and "label" in raw[name][0]
+            ),
+            None,
+        )
+        if labeled is None:
+            raise SystemExit(
+                "custom task needs at least one split with a 'label' column "
+                "to infer the label set (got only unlabeled files)"
+            )
+        label_list = sorted({str(r["label"]) for r in labeled})
         label2id = {l: i for i, l in enumerate(label_list)}
         id2label = {i: l for l, i in label2id.items()}
         num_labels = len(label_list)

@@ -171,6 +171,31 @@ def test_parse_split_string():
         parse_split_string("0,0,0", 10)
 
 
+def test_parse_split_string_reference_differential():
+    """Bit-parity with get_train_valid_test_split_ (data_utils.py:163-187):
+    cumulative int(round(frac*size)) bounds, then the terminal rounding
+    excess subtracted from EVERY bound (not clamped on the tail) — so
+    small-n splits never collapse a middle range the reference keeps.
+
+    Expected bounds are precomputed by hand-executing the reference
+    algorithm (golden values, not a re-derivation in code)."""
+    cases = [
+        # (split, n) -> reference splits_index [0, b1, b2, n]
+        ("1,1,1", 10, [0, 4, 7, 10]),  # cum [0,3,6,9], diff -1 → +1 each
+        ("1,1,1", 4, [0, 2, 3, 4]),  # cum [0,1,2,3], diff -1
+        ("969,30,1", 997, [0, 966, 996, 997]),  # diff 0
+        ("8,1,1", 7, [0, 5, 6, 7]),  # cum [0,6,7,8], diff +1 → -1 each
+        ("949,50,1", 33, [0, 31, 33, 33]),  # zero-width test split survives
+        ("90/5/5", 21, [0, 19, 20, 21]),  # '/' separator form
+        ("100", 13, [0, 13, 13, 13]),  # single-value form
+        ("2,1", 9, [0, 6, 9, 9]),  # two-value form pads a zero
+    ]
+    for split, n, expect in cases:
+        got = parse_split_string(split, n)
+        bounds = [got[0].start, got[0].stop, got[1].stop, got[2].stop]
+        assert bounds == expect, (split, n, bounds, expect)
+
+
 def test_split_datasets_and_iterator_rewind(tmp_path):
     prefix, _ = write_corpus(tmp_path, n_docs=100)
     mcfg = MegatronDataConfig(data_path=prefix, split="8,1,1", seq_length=16, seed=0)

@@ -46,7 +46,7 @@ def snippets_from(paths, n, rng, width=400, exts=(".py", ".md", ".rst", ".txt"))
         for _ in range(min(3, 1 + len(text) // (4 * width))):
             if len(out) >= n:
                 break
-            start = rng.randrange(0, len(text) - width)
+            start = rng.randrange(0, len(text) - width + 1)
             snippet = " ".join(text[start : start + width].split())
             if snippet:
                 out.append(snippet)
