@@ -209,10 +209,13 @@ def decoder_stack(
 
     block = LlamaDecoderLayer
     if module.remat:
+        from relora_tpu.models.params_util import remat_policy
+
         block = nn.remat(
             block,
             prevent_cse=not module.scan_layers,
             static_argnums=(4,),  # deterministic
+            policy=remat_policy(getattr(module, "remat_policy", "full")),
         )
     layer_kwargs = dict(
         config=cfg,
@@ -264,6 +267,7 @@ class LlamaForCausalLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = False
+    remat_policy: str = "full"  # 'full' | 'dots' (see params_util.remat_policy)
     attention_impl: str = "auto"
     # f32 logits are the safe default; bf16 halves the (B, S, vocab) HBM
     # footprint — the loss upcasts to f32 either way
@@ -302,6 +306,7 @@ class LlamaBackbone(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = False
+    remat_policy: str = "full"
     attention_impl: str = "auto"
 
     @nn.compact
@@ -322,6 +327,7 @@ class LlamaForSequenceClassification(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = False
+    remat_policy: str = "full"
     attention_impl: str = "auto"
 
     @nn.compact
@@ -332,6 +338,7 @@ class LlamaForSequenceClassification(nn.Module):
             dtype=self.dtype,
             scan_layers=self.scan_layers,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             attention_impl=self.attention_impl,
             name="model",
         )(input_ids, deterministic=deterministic)

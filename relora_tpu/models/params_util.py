@@ -17,6 +17,24 @@ from flax import linen as nn
 PyTree = Any
 
 
+def remat_policy(name: str):
+    """Rematerialization policy for ``nn.remat`` by config name.
+
+    - ``"full"``: save nothing, recompute the whole layer in backward (the
+      reference's gradient checkpointing, modeling_llama.py:552-567) —
+      minimum memory, ~1/3 extra FLOPs.
+    - ``"dots"``: save matmul outputs without batch dims
+      (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``) —
+      recomputes only the cheap elementwise/softmax work; more memory,
+      less recompute.  The right trade when HBM headroom exists.
+    """
+    if name == "full":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"Unknown remat policy {name!r} (use 'full' or 'dots')")
+
+
 def init_params(model: nn.Module, rng: jax.Array, *sample_args, **sample_kwargs) -> PyTree:
     """Initialize and return a plain (unboxed) param tree.
 

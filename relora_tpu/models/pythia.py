@@ -154,6 +154,7 @@ class GPTNeoXForCausalLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = False
+    remat_policy: str = "full"  # 'full' | 'dots' (see params_util.remat_policy)
     attention_impl: str = "auto"
     logits_dtype: jnp.dtype = jnp.float32
 
@@ -191,7 +192,14 @@ class GPTNeoXForCausalLM(nn.Module):
 
         block = NeoXLayer
         if self.remat:
-            block = nn.remat(block, prevent_cse=not self.scan_layers, static_argnums=(4,))
+            from relora_tpu.models.params_util import remat_policy
+
+            block = nn.remat(
+                block,
+                prevent_cse=not self.scan_layers,
+                static_argnums=(4,),
+                policy=remat_policy(self.remat_policy),
+            )
         layer_kwargs = dict(
             config=cfg, lora=self.lora, dtype=self.dtype, attention_impl=self.attention_impl
         )

@@ -51,17 +51,26 @@ def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     return out.reshape(B, S, N, H).astype(q.dtype)
 
 
+def flash_block_size(S: int, S_kv: int) -> Optional[int]:
+    """Tile size for the pallas flash kernel, or None when the lengths are
+    sub-tile / non-128-aligned and the kernel can't apply.  The kernel's
+    _verify_block requires exact divisibility (e.g. S=768 with block 512 is
+    rejected), so this picks the largest of 512/256/128 dividing both."""
+    if S < 128 or S_kv < 128 or S % 128 or S_kv % 128:
+        return None
+    return next(b for b in (512, 256, 128) if S % b == 0 and S_kv % b == 0)
+
+
 def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
         flash_attention,
     )
 
-    S, S_kv = q.shape[1], k.shape[1]
-    if S < 128 or S_kv < 128 or S % 128 or S_kv % 128:
-        # shorter than one tile (e.g. the (1, 8) param-init trace) or
-        # non-tile-aligned: the flash tiling can't apply; XLA's fused path
-        # is fine at these sizes
+    blk = flash_block_size(q.shape[1], k.shape[1])
+    if blk is None:
+        # e.g. the (1, 8) param-init trace: XLA's fused path is fine at
+        # these sizes
         return jax.nn.dot_product_attention(
             q, k, v, scale=scale, is_causal=causal
         )
@@ -70,9 +79,6 @@ def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     # to the projections/ring paths; this materialization is per-call)
     k, v = _expand_grouped_kv(q, k, v)
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    # largest tile that divides both lengths (the kernel's _verify_block
-    # requires exact divisibility, e.g. S=768 with blk=512 is rejected)
-    blk = next(b for b in (512, 256, 128) if S % b == 0 and S_kv % b == 0)
     sizes = BlockSizes(
         block_q=blk,
         block_k_major=blk,

@@ -84,6 +84,7 @@ def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingC
         dtype=compute_dtype,
         scan_layers=True,
         remat=cfg.remat,
+        remat_policy=cfg.remat_policy,
         attention_impl=attention_impl,
         logits_dtype=jnp.bfloat16 if cfg.bf16_logits else jnp.float32,
     )

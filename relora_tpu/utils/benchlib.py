@@ -22,6 +22,7 @@ def run_throughput_bench(
     grad_accum: int = 1,
     seq: int = 1024,
     remat: bool = True,
+    remat_policy: str = "full",
     loss_impl: str = "dense",
     vocab_chunk: int = 8192,
     logits_dtype: str = "f32",
@@ -63,6 +64,7 @@ def run_throughput_bench(
         dtype=jnp.bfloat16,
         scan_layers=True,
         remat=remat,
+        remat_policy=remat_policy,
         attention_impl=attn,
         logits_dtype=jnp.bfloat16 if logits_dtype == "bf16" else jnp.float32,
     )

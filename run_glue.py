@@ -48,9 +48,12 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint", default=None, help="relora-tpu checkpoint dir (model_N)")
     p.add_argument("--tokenizer", required=True,
                    help="HF tokenizer name/dir, or a local tokenizers-json file")
-    p.add_argument("--lr", type=float, default=2e-5)
-    p.add_argument("--batch_size", type=int, default=32)
-    p.add_argument("--num_epochs", type=int, default=3)
+    # reference HF-Trainer flag names accepted as aliases (run_glue.py parity)
+    p.add_argument("--lr", "--learning_rate", type=float, default=2e-5)
+    p.add_argument(
+        "--batch_size", "--per_device_train_batch_size", type=int, default=32
+    )
+    p.add_argument("--num_epochs", "--num_train_epochs", type=int, default=3)
     p.add_argument("--max_seq_length", "--max_length", dest="max_seq_length",
                    type=int, default=128)
     p.add_argument("--pad_to_max_length", type=_flag, default=True,
@@ -303,7 +306,11 @@ def main(argv=None):
         do_eval=args.do_eval,
     )
 
-    result = {"task": args.task_name, **metrics}
+    # parity: HF Trainer prefixes evaluation metrics with eval_ in
+    # all_results.json (trainer.evaluate -> eval_accuracy etc.)
+    result = {"task": args.task_name}
+    for k, v in metrics.items():
+        result[k if k.startswith(("eval_", "train_")) else f"eval_{k}"] = v
     print(json.dumps(result))
     if args.output_dir:
         os.makedirs(args.output_dir, exist_ok=True)

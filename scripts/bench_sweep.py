@@ -40,6 +40,7 @@ def main() -> None:
     p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
     p.add_argument("--loss-impl", default="dense", choices=["dense", "chunked"])
     p.add_argument("--vocab-chunk", type=int, default=8192)
     p.add_argument("--logits-dtype", default="f32", choices=["f32", "bf16"])
@@ -65,6 +66,7 @@ def main() -> None:
         grad_accum=args.grad_accum,
         seq=args.seq,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         loss_impl=args.loss_impl,
         vocab_chunk=args.vocab_chunk,
         logits_dtype=args.logits_dtype,
@@ -79,7 +81,8 @@ def main() -> None:
             {
                 "label": args.label
                 or f"{args.model} mb{args.micro_batch} ga{args.grad_accum} seq{args.seq}"
-                f" remat={int(args.remat)} {args.loss_impl} {args.logits_dtype}"
+                f" remat={int(args.remat)}:{args.remat_policy}"
+                f" {args.loss_impl} {args.logits_dtype}"
                 f" attn={args.attn}",
                 "tokens_per_sec": res["tokens_per_sec"],
                 "mfu": res["mfu"],

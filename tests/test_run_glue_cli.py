@@ -1,0 +1,141 @@
+"""End-to-end tests of the run_glue.py CLI on synthetic custom-file tasks
+(no network): train+eval+predict round trip, and the predict-only path that
+infers the label set from a labeled validation split while the test file is
+unlabeled (parity surface: run_glue.py:209-623)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.test_glue import TINY  # noqa: E402
+
+
+def _write_tokenizer(path):
+    """Train a tiny byte-level BPE on synthetic text (the air-gapped
+    tokenizer-json path load_tokenizer supports)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=TINY.vocab_size, special_tokens=["<unk>", "<pad>"]
+    )
+    texts = [f"alpha beta gamma {i}" for i in range(50)] + [
+        f"delta epsilon zeta {i}" for i in range(50)
+    ]
+    tok.train_from_iterator(texts, trainer)
+    tok.save(str(path))
+    return str(path)
+
+
+def _write_splits(tmp_path, labeled_test=True):
+    """Two trivially separable classes (distinct token vocabularies)."""
+    rows_a = [{"sentence": f"alpha beta gamma {i}", "label": "pos"} for i in range(24)]
+    rows_b = [{"sentence": f"delta epsilon zeta {i}", "label": "neg"} for i in range(24)]
+    train = rows_a[:16] + rows_b[:16]
+    val = rows_a[16:20] + rows_b[16:20]
+    test = rows_a[20:] + rows_b[20:]
+    paths = {}
+    for name, rows in (("train", train), ("validation", val), ("test", test)):
+        p = tmp_path / f"{name}.json"
+        with open(p, "w") as f:
+            for r in rows:
+                if name == "test" and not labeled_test:
+                    r = {"sentence": r["sentence"]}
+                f.write(json.dumps(r) + "\n")
+        paths[name] = str(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def model_json(tmp_path_factory):
+    p = tmp_path_factory.mktemp("cfg") / "model.json"
+    p.write_text(json.dumps(TINY.to_dict()))
+    return str(p)
+
+
+@pytest.mark.slow
+def test_cli_train_eval_predict_roundtrip(tmp_path, model_json):
+    import run_glue
+
+    tok = _write_tokenizer(tmp_path / "tok.json")
+    paths = _write_splits(tmp_path)
+    out = tmp_path / "out"
+    run_glue.main(
+        [
+            "--task_name", "synth",
+            "--model_config", model_json,
+            "--tokenizer", tok,
+            "--train_file", paths["train"],
+            "--validation_file", paths["validation"],
+            "--test_file", paths["test"],
+            "--do_train", "true", "--do_eval", "true", "--do_predict", "true",
+            "--num_train_epochs", "4",
+            "--per_device_train_batch_size", "8",
+            "--learning_rate", "5e-3",
+            "--max_seq_length", "16",
+            "--output_dir", str(out),
+            "--seed", "0",
+        ]
+    )
+    results = json.load(open(out / "all_results.json"))
+    assert "eval_accuracy" in results
+    # separable vocabularies: must beat chance clearly after 4 epochs
+    assert results["eval_accuracy"] >= 0.75, results
+    preds = (out / "predict_results_synth.txt").read_text().splitlines()
+    # header + one line per test row, labels written as NAMES
+    assert len(preds) == 9
+    assert all(line.split("\t")[1] in ("pos", "neg") for line in preds[1:])
+
+
+@pytest.mark.slow
+def test_cli_predict_only_unlabeled_test(tmp_path, model_json):
+    """--do_predict with an unlabeled test file + labeled validation file:
+    the label set is inferred from validation (the fix for predict-only
+    custom runs), no training happens."""
+    import run_glue
+
+    tok = _write_tokenizer(tmp_path / "tok.json")
+    paths = _write_splits(tmp_path, labeled_test=False)
+    out = tmp_path / "out"
+    run_glue.main(
+        [
+            "--task_name", "synth",
+            "--model_config", model_json,
+            "--tokenizer", tok,
+            "--validation_file", paths["validation"],
+            "--test_file", paths["test"],
+            "--do_train", "false", "--do_eval", "false", "--do_predict", "true",
+            "--max_seq_length", "16",
+            "--output_dir", str(out),
+            "--seed", "0",
+        ]
+    )
+    preds = (out / "predict_results_synth.txt").read_text().splitlines()
+    assert len(preds) == 9
+    assert all(line.split("\t")[1] in ("pos", "neg") for line in preds[1:])
+
+
+def test_cli_unlabeled_only_raises(tmp_path, model_json):
+    """All-unlabeled custom input fails loudly instead of KeyError."""
+    import run_glue
+
+    tok = _write_tokenizer(tmp_path / "tok.json")
+    paths = _write_splits(tmp_path, labeled_test=False)
+    with pytest.raises(SystemExit, match="label"):
+        run_glue.main(
+            [
+                "--task_name", "synth",
+                "--model_config", model_json,
+                "--tokenizer", tok,
+                "--test_file", paths["test"],
+                "--do_train", "false", "--do_eval", "false", "--do_predict", "true",
+                "--max_seq_length", "16",
+                "--output_dir", str(tmp_path / "out2"),
+                "--seed", "0",
+            ]
+        )
