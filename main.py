@@ -33,6 +33,9 @@ def main(argv=None) -> dict:
     from relora_tpu.utils.logging import honor_platform_request
 
     honor_platform_request()
+    from relora_tpu.utils.logging import enable_compile_cache
+
+    enable_compile_cache()
     from relora_tpu.config.training import parse_train_args
     from relora_tpu.utils.logging import get_logger
 

@@ -44,6 +44,9 @@ def run_throughput_bench(
     on-chip; the 1B recipe amortizes its cost over 1000 steps, so it is
     deliberately excluded from the per-step figure).
     """
+    from relora_tpu.utils.logging import enable_compile_cache
+
+    enable_compile_cache()
     import jax
     import jax.numpy as jnp
 

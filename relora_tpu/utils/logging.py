@@ -169,3 +169,25 @@ def honor_platform_request() -> None:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+
+
+def enable_compile_cache(path: str = "") -> None:
+    """Turn on JAX's persistent compilation cache for this process.
+
+    Repeat compiles of the same program (re-running bench configs, resumed
+    training, sweep retries) then load from disk instead of recompiling —
+    which matters doubly where compilation is remote and slow.  Opt out with
+    RELORA_TPU_COMPILE_CACHE=0; override the directory with
+    RELORA_TPU_COMPILE_CACHE=<dir>.  Call before the first jax computation.
+    """
+    env = os.environ.get("RELORA_TPU_COMPILE_CACHE", "1")
+    if env == "0":
+        return
+    cache_dir = path or (env if env not in ("", "1") else "/tmp/relora_tpu_compile_cache")
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knobs: compile as usual

@@ -60,14 +60,4 @@ python main.py "${common[@]}" --lr 2e-3 --use_peft true --lora_r 128 \
     --save_dir "$WORK/relora" --autoresume true
 
 echo "=== results ==="
-python - "$WORK" <<'EOF'
-import json, sys
-for name in ("full_rank", "relora"):
-    evs = []
-    with open(f"{sys.argv[1]}/{name}/metrics.jsonl") as fh:
-        for line in fh:
-            rec = json.loads(line)
-            if "final_eval_loss" in rec:
-                evs.append((rec.get("_step"), rec["final_eval_loss"]))
-    print(name, evs[-3:])
-EOF
+python tools/compare_runs.py full_rank="$WORK/full_rank" relora="$WORK/relora"

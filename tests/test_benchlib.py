@@ -64,6 +64,25 @@ def test_remat_policy_unknown_raises():
         remat_policy("bogus")
 
 
+def test_enable_compile_cache_env_control(monkeypatch):
+    """RELORA_TPU_COMPILE_CACHE=0 leaves the config untouched; a path value
+    selects the directory; default picks the shared tmp dir."""
+    from relora_tpu.utils.logging import enable_compile_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.setenv("RELORA_TPU_COMPILE_CACHE", "0")
+        enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == before
+
+        monkeypatch.setenv("RELORA_TPU_COMPILE_CACHE", "/tmp/cache_test_dir")
+        enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == "/tmp/cache_test_dir"
+    finally:
+        # restore the conftest's cache config for later tests
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
 def test_bench_configs_name_real_models():
     import bench
 

@@ -1,0 +1,94 @@
+"""Compare training runs' eval-loss trajectories (the loss-parity artifact).
+
+Reads each run's ``metrics.jsonl``, aligns eval losses by update step, and
+prints a markdown table plus a one-line JSON summary with the relative gap
+of every run against the first (the baseline).  This is the quality oracle
+BASELINE.json asks for: "C4 eval loss within 1% of full-rank".
+
+    python tools/compare_runs.py full_rank=/tmp/loss_parity/full_rank \
+        relora=/tmp/loss_parity/relora
+
+Eval records use the reference's own wandb key ``final_eval_loss`` for
+BOTH mid-training and end-of-run evals (torchrun_main.py:862 quirk,
+preserved by utils/logging.py) — each carries ``_step``, so the trajectory
+aligns by step and the last record is the final loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def read_metrics(path: str):
+    evals = {}  # step -> eval loss (mid-training and final share the key)
+    final = None
+    fn = os.path.join(path, "metrics.jsonl") if os.path.isdir(path) else path
+    with open(fn) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "final_eval_loss" in rec:
+                final = rec["final_eval_loss"]
+                step = rec.get("_step", rec.get("update_step"))
+                if step is not None:
+                    evals[step] = final
+    return {"evals": evals, "final": final}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "runs",
+        nargs="+",
+        help="name=dir pairs; the first run is the baseline for gaps",
+    )
+    args = p.parse_args()
+
+    runs = []
+    for spec in args.runs:
+        if "=" not in spec:
+            sys.exit(f"run spec {spec!r} must be name=dir")
+        name, path = spec.split("=", 1)
+        runs.append((name, read_metrics(path)))
+
+    base_name, base = runs[0]
+    steps = sorted(set().union(*(r["evals"] for _, r in runs)))
+    header = "| step | " + " | ".join(n for n, _ in runs) + " | gap vs " + base_name + " |"
+    print(header)
+    print("|" + "---|" * (len(runs) + 2))
+    for s in steps:
+        cells = []
+        for _, r in runs:
+            v = r["evals"].get(s)
+            cells.append(f"{v:.4f}" if v is not None else "—")
+        gaps = []
+        bv = base["evals"].get(s)
+        for _, r in runs[1:]:
+            v = r["evals"].get(s)
+            if bv and v:
+                gaps.append(f"{(v - bv) / bv * 100:+.2f}%")
+        print(f"| {s} | " + " | ".join(cells) + " | " + ", ".join(gaps) + " |")
+
+    summary = {"baseline": base_name}
+    for name, r in runs:
+        final = r["final"] if r["final"] is not None else (
+            r["evals"][max(r["evals"])] if r["evals"] else None
+        )
+        summary[name] = final
+    bfinal = summary[base_name]
+    if bfinal:
+        for name, r in runs[1:]:
+            if summary[name] is not None:
+                summary[f"{name}_gap_pct"] = round(
+                    (summary[name] - bfinal) / bfinal * 100, 3
+                )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,183 @@
+"""Per-device HBM budget planner for a model/mesh/recipe combination.
+
+Answers "does this fit?" before burning pod time — entirely via
+``jax.eval_shape`` (abstract shapes, zero allocation), so 7B-scale plans run
+on a laptop.  Accounts for:
+
+- frozen base params (bf16/f32, int8 or NF4+double-quant footprints),
+- LoRA factors + their Adam moments (the only optimizer state ReLoRA keeps),
+- full-rank Adam moments when --rank 0 (the comparison case),
+- gradients for trainables,
+- activation residuals at the chosen microbatch/seq under the remat policy
+  ('full' keeps per-layer boundaries; 'dots' adds the saved matmul outputs;
+  'none' estimates the dense residuals incl. the S^2 attention scores XLA
+  keeps for backward — measured on-chip, BASELINE.md round-2 finding 2),
+- the logits buffer (or its absence with --loss chunked).
+
+Sharding: each param leaf divides by the product of mesh axes its logical
+spec maps to (parallel/mesh.LOGICAL_RULES); activations divide by
+data*fsdp (batch) and sequence (seq axis).
+
+    python tools/plan_memory.py --model llama_7b --rank 256 --mesh fsdp=32,tensor=2 \
+        --micro-batch 8 --seq 2048 --chip v5p
+    python tools/plan_memory.py --model llama_1b --rank 128 --micro-batch 8 --seq 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHIP_HBM = {"v5e": 16e9, "v5p": 95e9, "v4": 32e9}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama_1b")
+    p.add_argument("--rank", type=int, default=128, help="0 = full-rank training")
+    p.add_argument("--mesh", default="", help="e.g. fsdp=8,tensor=2 (default: single chip)")
+    p.add_argument("--micro-batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--quantize", default=None, choices=[None, "int8", "nf4"])
+    p.add_argument("--remat", default="full", choices=["full", "dots", "none"])
+    p.add_argument("--loss", default="dense", choices=["dense", "chunked"])
+    p.add_argument("--chip", default="v5e", choices=sorted(CHIP_HBM))
+    args = p.parse_args()
+
+    # abstract-only tool: always run on CPU (eval_shape never touches a
+    # device, and waiting on a TPU tunnel to plan memory would be absurd)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from relora_tpu.utils.logging import honor_platform_request
+
+    honor_platform_request()
+    import jax
+    import jax.numpy as jnp
+
+    from relora_tpu.config.model import MODEL_ZOO, load_model_config
+    from relora_tpu.core.relora import LoraSpec, frozen_param_mask
+    from relora_tpu.models.llama import LlamaForCausalLM
+    from relora_tpu.models.params_util import logical_partition_specs
+    from relora_tpu.parallel.mesh import LOGICAL_RULES
+
+    mesh_factors = {}
+    if args.mesh:
+        for part in args.mesh.split(","):
+            k, v = part.split("=")
+            mesh_factors[k.strip()] = int(v)
+    n_devices = math.prod(mesh_factors.values()) if mesh_factors else 1
+    rules = dict(LOGICAL_RULES)
+
+    def shard_div(logical_spec) -> int:
+        """How many ways this leaf is split across the mesh."""
+        div = 1
+        for axis_name in logical_spec or ():
+            mesh_axes = rules.get(axis_name)
+            if mesh_axes is None:
+                continue
+            if isinstance(mesh_axes, str):
+                mesh_axes = (mesh_axes,)
+            for m in mesh_axes:
+                div *= mesh_factors.get(m, 1)
+        return div
+
+    cfg = MODEL_ZOO[args.model] if args.model in MODEL_ZOO else load_model_config(args.model)
+    spec = LoraSpec(r=args.rank, alpha=32, dropout=0.0) if args.rank else None
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    model = LlamaForCausalLM(cfg, lora=spec, dtype=dtype, scan_layers=True)
+    sample = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), sample))["params"]
+    specs = logical_partition_specs(model, sample)
+
+    import flax.linen as nn
+
+    abstract = nn.meta.unbox(abstract)
+
+    # the REAL trainability rule (core/relora.py::trainable_param_mask):
+    # everything trains except the frozen base kernels of LoRA-wrapped
+    # Denses — embeddings/norms/head carry Adam state too, and only those
+    # frozen kernels are ever quantized (ops/quant.py)
+    frozen_mask = frozen_param_mask(abstract) if args.rank else None
+
+    # --- params + optimizer + grads -----------------------------------
+    frozen_bytes = trainable_bytes = opt_bytes = grad_bytes = 0.0
+    flat = jax.tree_util.tree_flatten_with_path(abstract)[0]
+    flat_specs = {
+        tuple(str(getattr(k, "key", k)) for k in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]
+    }
+    flat_frozen = (
+        {
+            tuple(str(getattr(k, "key", k)) for k in path): f
+            for path, f in jax.tree_util.tree_flatten_with_path(frozen_mask)[0]
+        }
+        if frozen_mask is not None
+        else {}
+    )
+    for path, leaf in flat:
+        key = tuple(str(getattr(k, "key", k)) for k in path)
+        div = shard_div(flat_specs.get(key))
+        n = leaf.size / div
+        trainable = not flat_frozen.get(key, False) if args.rank else True
+        # param storage dtype: params are stored f32 (master) except the
+        # quantized frozen base
+        if trainable:
+            trainable_bytes += n * 4
+            opt_bytes += n * 4 * 2  # adam mu+nu f32
+            grad_bytes += n * 4
+        elif args.quantize == "int8":
+            frozen_bytes += n * (1 + 4 / 256)  # codes + per-channel scales
+        elif args.quantize == "nf4":
+            frozen_bytes += n * (0.5 + 1 / 64 + 4 / 4096)  # nibbles + dq scales
+        else:
+            frozen_bytes += n * 4
+    # --- activations ---------------------------------------------------
+    B, S, H, L = args.micro_batch, args.seq, cfg.hidden_size, cfg.num_hidden_layers
+    batch_div = mesh_factors.get("data", 1) * mesh_factors.get("fsdp", 1)
+    seq_div = mesh_factors.get("sequence", 1)
+    bytes_el = 2 if args.dtype == "bf16" else 4
+    tok = (B / batch_div) * (S / seq_div)
+    heads = cfg.num_attention_heads / mesh_factors.get("tensor", 1)
+    if args.remat == "full":
+        act = L * tok * H * bytes_el  # layer-boundary residual per layer
+    elif args.remat == "dots":
+        # boundaries + saved matmul outputs (qkv, attn out, 3 mlp)
+        inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
+        per_layer = tok * (H * 5 + inter * 3) * bytes_el
+        act = L * per_layer
+    else:  # none: dense residuals incl. f32 S^2 attention probs (measured)
+        inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
+        per_layer = tok * (H * 8 + inter * 3) * bytes_el + (
+            (B / batch_div) * heads * (S / seq_div) * S * 4
+        )
+        act = L * per_layer
+    logits = 0 if args.loss == "chunked" else tok * cfg.vocab_size * 4
+    total = frozen_bytes + trainable_bytes + opt_bytes + grad_bytes + act + logits
+    hbm = CHIP_HBM[args.chip]
+    out = {
+        "model": args.model,
+        "devices": n_devices,
+        "per_device_gb": {
+            "frozen_params": round(frozen_bytes / 1e9, 3),
+            "trainable_params": round(trainable_bytes / 1e9, 3),
+            "adam_moments": round(opt_bytes / 1e9, 3),
+            "grads": round(grad_bytes / 1e9, 3),
+            "activations": round(act / 1e9, 3),
+            "logits": round(logits / 1e9, 3),
+            "total": round(total / 1e9, 3),
+        },
+        "chip": args.chip,
+        "hbm_gb": hbm / 1e9,
+        "fits": total < hbm * 0.9,  # leave 10% for XLA workspace
+        "headroom_gb": round((hbm - total) / 1e9, 2),
+    }
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
