@@ -54,6 +54,7 @@ class MegatronDataConfig:
         known = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in raw.items() if k in known and v not in ("", None)}
         cfg = cls(**kwargs)
+        _check_neox_batch_keys(raw, path)
         if cfg.data_impl not in ("mmap", "lazy", "cached", "infer"):
             raise NotImplementedError(
                 f"data_impl={cfg.data_impl!r}: supported are mmap/lazy/cached/infer"
@@ -61,6 +62,56 @@ class MegatronDataConfig:
         if cfg.data_path is None and not cfg.train_data_paths:
             raise ValueError("config needs train_data_paths or data_path")
         return cfg
+
+
+def _check_neox_batch_keys(raw: dict, path: str) -> None:
+    """Cross-check NeoX batch-arithmetic keys we deliberately don't consume.
+
+    The reference solves/validates train_batch_size = micro_batch_per_gpu *
+    gradient_accumulation_steps * world_size when loading a NeoX YAML
+    (megatron_dataset/arguments.py:754-812). We collapse NeoXArgs to the data
+    surface the training path reads, so those keys are ignored here — but a
+    YAML whose batch fields are internally inconsistent should warn instead
+    of being silently accepted.
+    """
+    tbs = raw.get("train_batch_size")
+    micro = raw.get("train_micro_batch_size_per_gpu")
+    ga = raw.get("gradient_accumulation_steps")
+    present = {
+        k: v
+        for k, v in (
+            ("train_batch_size", tbs),
+            ("train_micro_batch_size_per_gpu", micro),
+            ("gradient_accumulation_steps", ga),
+        )
+        if v is not None
+    }
+    if present:
+        logger.warning(
+            "%s: NeoX batch keys %s are not consumed by relora_tpu "
+            "(batch arithmetic is set by the training config, not the data YAML)",
+            path,
+            sorted(present),
+        )
+    if tbs is not None and micro is not None and ga is not None:
+        try:
+            tbs_i, micro_i, ga_i = int(tbs), int(micro), int(ga)
+        except (TypeError, ValueError):
+            return
+        # world_size isn't knowable from the YAML; consistency requires
+        # train_batch_size to be a positive multiple of micro * grad_accum
+        per_rank = micro_i * ga_i
+        if per_rank <= 0 or tbs_i <= 0 or tbs_i % per_rank != 0:
+            logger.warning(
+                "%s: inconsistent NeoX batch arithmetic: train_batch_size=%s "
+                "is not a positive multiple of train_micro_batch_size_per_gpu=%s "
+                "* gradient_accumulation_steps=%s (reference validates this in "
+                "arguments.py:754-812)",
+                path,
+                tbs,
+                micro,
+                ga,
+            )
 
 
 def parse_split_string(split: str, n: int) -> List[range]:

@@ -36,6 +36,37 @@ def _expand_grouped_kv(q, k, v):
     return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
+def _grouped_equal_heads_call(q, k, v, equal_heads_fn) -> jax.Array:
+    """Apply an equal-head-count attention kernel to grouped-query inputs
+    WITHOUT materializing expanded K/V: one call per group slice, every
+    slice reading the same K/V buffers.  ``g`` is a small static int, so the
+    unrolled loop adds g-1 kernel launches, not g× K/V HBM."""
+    n, n_kv = q.shape[2], k.shape[2]
+    if n == n_kv:
+        return equal_heads_fn(q, k, v)
+    if n % n_kv:
+        raise ValueError(f"num_heads={n} must divide by kv_heads={n_kv}")
+    g = n // n_kv
+    B, S, _, H = q.shape
+    qg = q.reshape(B, S, n_kv, g, H)
+    outs = [equal_heads_fn(qg[:, :, :, j, :], k, v) for j in range(g)]
+    return jnp.stack(outs, axis=3).reshape(B, S, n, H)
+
+
+def _pallas_min_seq() -> int:
+    """Sequence length at/above which impl='auto' prefers the pallas flash
+    kernel on TPU.  Default 4096 is provisional (XLA wins at 1024, measured;
+    the crossover awaits scripts/bench_attention.py on-chip).  0 disables."""
+    import os
+
+    raw = os.environ.get("RELORA_TPU_PALLAS_MIN_SEQ", "4096")
+    try:
+        val = int(raw)
+    except ValueError:
+        return 4096
+    return val if val > 0 else 1 << 62
+
+
 def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     B, S, N, H = q.shape
     n_kv = k.shape[2]
@@ -74,11 +105,6 @@ def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
         return jax.nn.dot_product_attention(
             q, k, v, scale=scale, is_causal=causal
         )
-    # the pallas kernel wants (batch, heads, seq, head_dim) with equal head
-    # counts — grouped K/V are expanded here (the GQA HBM win still applies
-    # to the projections/ring paths; this materialization is per-call)
-    k, v = _expand_grouped_kv(q, k, v)
-    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
     sizes = BlockSizes(
         block_q=blk,
         block_k_major=blk,
@@ -92,10 +118,16 @@ def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
         block_k_dq=blk,
         block_q_dq=blk,
     )
-    out = flash_attention(
-        qt, kt, vt, causal=causal, sm_scale=scale, block_sizes=sizes
-    )
-    return out.swapaxes(1, 2)
+
+    def equal_heads(qq, kk, vv):
+        # the pallas kernel wants (batch, heads, seq, head_dim)
+        qt, kt, vt = (x.swapaxes(1, 2) for x in (qq, kk, vv))
+        out = flash_attention(
+            qt, kt, vt, causal=causal, sm_scale=scale, block_sizes=sizes
+        )
+        return out.swapaxes(1, 2)
+
+    return _grouped_equal_heads_call(q, k, v, equal_heads)
 
 
 def dot_product_attention(
@@ -109,13 +141,19 @@ def dot_product_attention(
 ) -> jax.Array:
     """Causal SDPA over ``(B, S, N, H)`` tensors.
 
-    ``impl='auto'`` resolves to the XLA fused path (TPU-friendly on every
-    backend); 'pallas' opts into the handwritten flash kernel.
+    ``impl='auto'`` resolves by sequence length: the XLA fused path (which
+    beat the pallas kernel by 5% at seq 1024 on the v5e, BASELINE.md r2) up
+    to ``PALLAS_MIN_SEQ``-1, the pallas flash kernel above — on TPU only.
+    The threshold is provisional pending the op-level A/B at 1k/4k/16k
+    (scripts/bench_attention.py); override with RELORA_TPU_PALLAS_MIN_SEQ
+    (0 disables the pallas dispatch entirely).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl == "auto":
         impl = "xla"
+        if q.shape[1] >= _pallas_min_seq() and jax.default_backend() == "tpu":
+            impl = "pallas"
     if impl == "xla":
         return jax.nn.dot_product_attention(q, k, v, scale=scale, is_causal=causal)
     if impl == "pallas":

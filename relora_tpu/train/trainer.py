@@ -672,13 +672,15 @@ class Trainer:
         every ``sync_every`` batches (and once at the end) — the reference's
         per-batch ``.item()`` round trip is the kind of host sync the train
         loop carefully lags, and through the sandbox's device tunnel it
-        dominates eval wall time.  The token-target check therefore fires at
-        drain points, overshooting by at most ``sync_every - 1`` batches
-        (the reference itself overshoots by up to one batch).
+        dominates eval wall time.  The token target is tracked host-side from
+        batch shapes (free — no device sync), so the loop drains early when
+        the target is near and overshoots by at most one batch (same bound as
+        the reference), not ``sync_every - 1``.
         """
         pending: list = []  # device-side partial sums, drained in one pull
         loss_sum = 0.0
         n_tokens = 0.0
+        expected_tokens = 0  # host-side upper bound on device n_tokens
 
         def drain():
             nonlocal loss_sum, n_tokens
@@ -701,7 +703,10 @@ class Trainer:
 
         for arr in eval_iter:
             pending.append(self._eval_step(self.state.params, self.device_batch(arr)))
-            if len(pending) >= max(sync_every, 1):
+            expected_tokens += int(np.asarray(arr).size)
+            if len(pending) >= max(sync_every, 1) or (
+                target_tokens > 0 and expected_tokens >= target_tokens
+            ):
                 drain()
                 if target_tokens > 0 and n_tokens >= target_tokens:
                     break

@@ -28,6 +28,7 @@ def run_throughput_bench(
     logits_dtype: str = "f32",
     attn: str = "auto",
     rank: Optional[int] = 128,
+    quantize: Optional[str] = None,
     dropout: float = 0.1,
     warmup_steps: int = 3,
     measure_steps: int = 10,
@@ -60,7 +61,9 @@ def run_throughput_bench(
     from relora_tpu.train.step import make_train_step
 
     cfg = MODEL_ZOO[model_name]
-    spec = LoraSpec(r=rank, alpha=32, dropout=dropout) if rank else None
+    spec = (
+        LoraSpec(r=rank, alpha=32, dropout=dropout, quantize=quantize) if rank else None
+    )
     model = LlamaForCausalLM(
         cfg,
         lora=spec,
@@ -87,7 +90,9 @@ def run_throughput_bench(
     )
     rng = jax.random.PRNGKey(2)
 
-    for i in range(warmup_steps):
+    # always at least one untimed step: primes the compile cache and binds
+    # `metrics` for the pre-measure sync even when warmup_steps == 0
+    for i in range(max(warmup_steps, 1)):
         state, metrics = step(state, batch, jax.random.fold_in(rng, i))
     if magnitude_reset:
         from relora_tpu.core.optim import reset_optimizer_state
@@ -114,6 +119,12 @@ def run_throughput_bench(
 
     tokens_per_update = grad_accum * micro_batch * seq
     tokens_per_sec = tokens_per_update * measure_steps / dt
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        hbm_peak_gb = round(peak / 1e9, 2) if peak is not None else None
+    except Exception:
+        hbm_peak_gb = None
     # 6*N per token fwd+bwd on the dense (equivalent) params
     n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
     mfu = tokens_per_sec * 6 * n_params / peak_flops
@@ -123,5 +134,6 @@ def run_throughput_bench(
         "step_time_s": round(dt / measure_steps, 4),
         "tokens_per_update": tokens_per_update,
         "loss": final_loss,
+        "hbm_peak_gb": hbm_peak_gb,
         "device": str(jax.devices()[0]),
     }

@@ -16,6 +16,7 @@ import logging
 import os
 import sys
 import time
+import warnings
 from typing import Any, Mapping, Optional
 
 _LOGGERS: dict[str, logging.Logger] = {}
@@ -94,6 +95,15 @@ class MetricsLogger:
         if run_dir is not None:
             os.makedirs(run_dir, exist_ok=True)
             self._fh = open(os.path.join(run_dir, "metrics.jsonl"), "a")
+            if config:
+                # offline equivalent of the wandb config capture
+                # (torchrun_main.py:639-655): lets analysis tools (e.g.
+                # plot_metrics.py scaling) read run hyperparams without wandb
+                try:
+                    with open(os.path.join(run_dir, "run_config.json"), "w") as f:
+                        json.dump(dict(config), f, indent=2, default=str)
+                except OSError as e:
+                    get_logger().warning(f"could not write run_config.json: {e}")
         if use_wandb:
             try:
                 import wandb  # type: ignore
@@ -183,6 +193,14 @@ def enable_compile_cache(path: str = "") -> None:
     env = os.environ.get("RELORA_TPU_COMPILE_CACHE", "1")
     if env == "0":
         return
+    if env not in ("", "1") and not (os.path.isabs(env) or os.sep in env):
+        # 'true'/'yes'/etc. would silently become a relative './true' cache dir
+        warnings.warn(
+            f"RELORA_TPU_COMPILE_CACHE={env!r} is not a path; expected '0', '1', "
+            "or a directory path. Using the default cache dir.",
+            stacklevel=2,
+        )
+        env = "1"
     cache_dir = path or (env if env not in ("", "1") else "/tmp/relora_tpu_compile_cache")
     import jax
 

@@ -46,11 +46,20 @@ def main() -> None:
     p.add_argument("--logits-dtype", default="f32", choices=["f32", "bf16"])
     p.add_argument("--attn", default="auto")
     p.add_argument("--rank", type=int, default=128)
+    p.add_argument(
+        "--quantize", default="", choices=["", "int8", "nf4"], help="frozen-base storage"
+    )
     p.add_argument("--dropout", type=float, default=0.1)
     p.add_argument("--prng", default="", help="jax_default_prng_impl override (e.g. rbg)")
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--label", default="")
+    p.add_argument(
+        "--out",
+        default="",
+        help="also append the JSON result line to this file (partial results "
+        "survive a tunnel outage and can be committed as they land)",
+    )
     args = p.parse_args()
 
     if args.prng:
@@ -72,25 +81,30 @@ def main() -> None:
         logits_dtype=args.logits_dtype,
         attn=args.attn,
         rank=args.rank,
+        quantize=args.quantize or None,
         dropout=args.dropout,
         warmup_steps=args.warmup,
         measure_steps=args.steps,
     )
-    print(
-        json.dumps(
-            {
-                "label": args.label
-                or f"{args.model} mb{args.micro_batch} ga{args.grad_accum} seq{args.seq}"
-                f" remat={int(args.remat)}:{args.remat_policy}"
-                f" {args.loss_impl} {args.logits_dtype}"
-                f" attn={args.attn}",
-                "tokens_per_sec": res["tokens_per_sec"],
-                "mfu": res["mfu"],
-                "step_time_s": res["step_time_s"],
-                "loss": round(res["loss"], 6),
-            }
-        )
+    line = json.dumps(
+        {
+            "label": args.label
+            or f"{args.model} mb{args.micro_batch} ga{args.grad_accum} seq{args.seq}"
+            f" remat={int(args.remat)}:{args.remat_policy}"
+            f" {args.loss_impl} {args.logits_dtype}"
+            f" attn={args.attn}"
+            + (f" quant={args.quantize}" if args.quantize else ""),
+            "tokens_per_sec": res["tokens_per_sec"],
+            "mfu": res["mfu"],
+            "step_time_s": res["step_time_s"],
+            "loss": round(res["loss"], 6),
+            "hbm_peak_gb": res.get("hbm_peak_gb"),
+        }
     )
+    print(line)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -19,6 +19,17 @@ STEPS_WARMUP="${STEPS_WARMUP:-1000}"
 STEPS_TOTAL="${STEPS_TOTAL:-8000}"
 BATCH="${BATCH:-24}"
 SEQ="${SEQ:-512}"
+MODEL="${MODEL:-llama_35m}"
+LORA_R="${LORA_R:-128}"
+CYCLE="${CYCLE:-1000}"
+EVAL_EVERY="${EVAL_EVERY:-500}"
+EVAL_TOKENS="${EVAL_TOKENS:-500000}"
+# run dirs are keyed by $MODEL so re-runs with a different MODEL (e.g. the
+# scaled-down CPU insurance pass) never reuse an incompatible warmup
+# checkpoint or autoresume from another model's branch dirs
+WARMUP_DIR="$WORK/warmup_$MODEL"
+FULL_DIR="$WORK/full_rank_$MODEL"
+RELORA_DIR="$WORK/relora_$MODEL"
 mkdir -p "$WORK"
 
 cat > "$WORK/data.yaml" <<EOF
@@ -29,35 +40,36 @@ seed: 0
 data_impl: mmap
 EOF
 
-common=(--megatron_dataset_config "$WORK/data.yaml" --model_config llama_35m
+common=(--megatron_dataset_config "$WORK/data.yaml" --model_config "$MODEL"
         --batch_size "$BATCH" --total_batch_size "$BATCH" --max_length "$SEQ"
-        --dtype bfloat16 --eval_every 500 --eval_tokens_during_training 500000
+        --dtype bfloat16 --eval_every "$EVAL_EVERY" --eval_tokens_during_training "$EVAL_TOKENS"
         --keep_checkpoints 2 --seed 0)
 
-if [ ! -d "$WORK/warmup/model_$STEPS_WARMUP" ]; then
+if [ ! -d "$WARMUP_DIR/model_$STEPS_WARMUP" ]; then
   echo "=== stage 1: shared full-rank warmup ($STEPS_WARMUP steps) ==="
   python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
       --warmup_steps 250 --cycle_length "$STEPS_WARMUP" --min_lr_ratio 0.9 \
       --num_training_steps "$STEPS_WARMUP" --save_every "$STEPS_WARMUP" \
-      --save_dir "$WORK/warmup"
+      --save_dir "$WARMUP_DIR"
 fi
 
 echo "=== stage 2a: full-rank branch (to $STEPS_TOTAL steps) ==="
 # warm-started schedules run over the REMAINING steps (trainer.py:242-251)
 python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
     --warmup_steps 250 --cycle_length "$((STEPS_TOTAL - STEPS_WARMUP))" \
-    --warmed_up_model "$WORK/warmup/model_$STEPS_WARMUP" \
+    --warmed_up_model "$WARMUP_DIR/model_$STEPS_WARMUP" \
     --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
-    --save_dir "$WORK/full_rank" --autoresume true
+    --save_dir "$FULL_DIR" --autoresume true
 
 echo "=== stage 2b: ReLoRA branch (to $STEPS_TOTAL steps) ==="
-python main.py "${common[@]}" --lr 2e-3 --use_peft true --lora_r 128 \
-    --relora 1000 --cycle_length 1000 --scheduler cosine_restarts \
+python main.py "${common[@]}" --lr 2e-3 --use_peft true --lora_r "$LORA_R" \
+    --relora "$CYCLE" --cycle_length "$CYCLE" --scheduler cosine_restarts \
     --warmup_steps 250 --restart_warmup_steps 100 \
     --reset_optimizer_on_relora true \
-    --warmed_up_model "$WORK/warmup/model_$STEPS_WARMUP" \
+    --warmed_up_model "$WARMUP_DIR/model_$STEPS_WARMUP" \
     --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
-    --save_dir "$WORK/relora" --autoresume true
+    --save_dir "$RELORA_DIR" --autoresume true
 
 echo "=== results ==="
-python tools/compare_runs.py full_rank="$WORK/full_rank" relora="$WORK/relora"
+python tools/compare_runs.py full_rank="$FULL_DIR" relora="$RELORA_DIR" \
+    --out "$WORK/compare.json"

@@ -1,0 +1,87 @@
+#!/bin/bash
+# TPU-tunnel recovery watcher (bench insurance).
+#
+# The sandbox's one-chip TPU tunnel has died mid-round in every round so far;
+# this watcher probes it and, the moment it answers, runs the queued on-chip
+# work in strict priority order — committing each stage's artifacts to git
+# immediately so a second outage can't erase a completed measurement:
+#   1. bench.py (the driver's headline number)        -> bench_results/
+#   2. remat/microbatch lever sweep (bench_sweep.py)  -> bench_results/r3_sweep.jsonl
+#   3. attention op-level A/B (bench_attention.py)    -> bench_results/r3_attn.jsonl
+#   4. quantized-base benches (int8 / nf4)            -> bench_results/r3_sweep.jsonl
+#   5. extra bench configs (250m, magnitude)          -> bench_results/
+#   6. loss-parity experiment (longest; CPU fallback exists)
+#
+# Usage: nohup bash scripts/tpu_recovery_watch.sh > /tmp/tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+RES=bench_results
+mkdir -p "$RES"
+
+commit() { # commit <message> -- <paths...>
+  local msg="$1"; shift; shift
+  git add "$@" 2>/dev/null
+  git diff --cached --quiet || git commit -q -m "$msg
+
+No-Verification-Needed: bench/measurement artifacts only" -- "$@"
+}
+
+probe() {
+  timeout -k 10 180 python -c \
+    "import jax,jax.numpy as jnp;print(float(jax.jit(lambda a:(a@a).sum())(jnp.ones((128,128)))))" \
+    >/dev/null 2>&1
+}
+
+sweep() { # sweep <args...>
+  timeout 1200 python scripts/bench_sweep.py --out "$RES/r3_sweep.jsonl" "$@" \
+    || echo "{\"error\": \"failed: $*\"}" >> "$RES/r3_sweep.jsonl"
+  commit "On-chip sweep: $*" -- "$RES/r3_sweep.jsonl"
+}
+
+echo "watcher start $(date -u +%FT%TZ)"
+while ! probe; do
+  echo "tunnel down $(date -u +%FT%TZ)"
+  sleep 240
+done
+echo "tunnel UP $(date -u +%FT%TZ)"
+
+# 1. headline bench
+timeout 1200 python bench.py > "$RES/BENCH_r3_local.json" 2>/tmp/bench_r3.err \
+  && commit "On-chip headline bench (r3 local)" -- "$RES/BENCH_r3_local.json"
+
+# 2. lever sweep: the unmeasured big levers first
+sweep --remat --remat-policy dots --label "remat dots-policy"
+sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 16 --label "remat dots chunked mb16"
+sweep --remat --remat-policy dots --dropout 0 --label "remat dots dropout0"
+sweep --remat --dropout 0 --label "remat full dropout0"
+sweep --remat --prng rbg --label "remat full rbg-prng"
+sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
+
+# 3. attention op-level A/B
+timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
+  > "$RES/r3_attn.jsonl" 2>/tmp/attn_r3.err \
+  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r3_attn.jsonl"
+
+# 4. quantized-base benches
+sweep --remat --quantize int8 --label "remat int8-base"
+sweep --remat --quantize nf4 --label "remat nf4-base"
+RELORA_TPU_PALLAS_QUANT=1 sweep --remat --quantize int8 --label "remat int8-base pallas-dequant"
+
+# 5. extra configs
+BENCH_CONFIG=llama_250m timeout 1200 python bench.py > "$RES/BENCH_r3_250m.json" 2>/dev/null \
+  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r3_250m.json"
+BENCH_CONFIG=llama_1b_magnitude timeout 1200 python bench.py > "$RES/BENCH_r3_magnitude.json" 2>/dev/null \
+  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r3_magnitude.json"
+
+# 6. loss parity (longest): 4000-step scaled config so both branches finish
+# inside a round (~1.6h on the v5e at 7k tok/s) — the CPU insurance run
+# (llama_9m, started separately) keeps its own WORK dir
+CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity \
+  STEPS_WARMUP=500 STEPS_TOTAL=4000 bash scripts/loss_parity.sh \
+  > /tmp/loss_parity.log 2>&1
+echo "loss_parity exit=$? $(date -u +%FT%TZ)"
+if [ -f /tmp/loss_parity/compare.json ]; then
+  cp /tmp/loss_parity/compare.json "$RES/r3_loss_parity_chip.json"
+  commit "On-chip loss-parity result" -- "$RES/r3_loss_parity_chip.json"
+fi
+echo "watcher done $(date -u +%FT%TZ)"

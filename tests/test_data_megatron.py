@@ -247,6 +247,47 @@ def test_yaml_config_accepts_reference_format(tmp_path):
     assert train[0]["input_ids"].shape == (17,)
 
 
+def test_yaml_inconsistent_neox_batch_keys_warn(tmp_path):
+    """Dropped NeoX batch keys are cross-checked: an inconsistent
+    train_batch_size/micro/grad_accum triple warns instead of loading
+    silently (reference solves this arithmetic in arguments.py:754-812)."""
+    import io
+    import logging as _logging
+
+    import yaml
+
+    def load_capturing(p):
+        buf = io.StringIO()
+        h = _logging.StreamHandler(buf)
+        lg = _logging.getLogger("relora_tpu.data.megatron")
+        lg.addHandler(h)
+        try:
+            MegatronDataConfig.from_yaml(str(p))
+        finally:
+            lg.removeHandler(h)
+        return buf.getvalue()
+
+    prefix, _ = write_corpus(tmp_path)
+    raw = {
+        "train_data_paths": [prefix],
+        "seq_length": 16,
+        "train_batch_size": 100,  # not a multiple of 8*3
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 3,
+    }
+    p = tmp_path / "bad_batch.yaml"
+    p.write_text(yaml.safe_dump(raw))
+    out = load_capturing(p)
+    assert "inconsistent NeoX batch arithmetic" in out
+
+    # consistent triple: only the "not consumed" notice, no inconsistency warning
+    raw["train_batch_size"] = 48
+    p2 = tmp_path / "ok_batch.yaml"
+    p2.write_text(yaml.safe_dump(raw))
+    out = load_capturing(p2)
+    assert "not consumed" in out and "inconsistent NeoX batch arithmetic" not in out
+
+
 def test_bert_mapping_builders():
     """BERT-style span builders: spans lie within documents, cover multiple
     sentences, respect target lengths, deterministic by seed."""

@@ -183,6 +183,49 @@ def test_attention_impls_agree():
     np.testing.assert_allclose(np.asarray(out_xla), np.asarray(out_naive), atol=2e-5)
 
 
+def test_grouped_equal_heads_call_matches_expansion():
+    """The pallas GQA path's per-group-slice dispatch (no K/V expansion)
+    must equal attention over explicitly expanded K/V."""
+    from relora_tpu.ops.attention import (
+        _expand_grouped_kv,
+        _grouped_equal_heads_call,
+        dot_product_attention,
+    )
+
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (2, 16, 8, 8))
+    kk = jax.random.normal(jax.random.fold_in(key, 1), (2, 16, 2, 8))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 16, 2, 8))
+
+    def eq(qq, k_, v_):
+        return dot_product_attention(qq, k_, v_, causal=True, impl="naive")
+
+    got = _grouped_equal_heads_call(q, kk, v, eq)
+    ke, ve = _expand_grouped_kv(q, kk, v)
+    want = dot_product_attention(q, ke, ve, causal=True, impl="naive")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_auto_dispatch_respects_backend_and_env(monkeypatch):
+    """auto only upgrades to pallas on TPU (never on the CPU test backend),
+    and the threshold env parses defensively."""
+    from relora_tpu.ops import attention as A
+
+    key = jax.random.PRNGKey(2)
+    q = jax.random.normal(key, (1, 256, 2, 8))
+    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "128")
+    out_auto = A.dot_product_attention(q, q, q, causal=True, impl="auto")
+    out_xla = A.dot_product_attention(q, q, q, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_xla), atol=0)
+
+    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "0")
+    assert A._pallas_min_seq() > 1 << 40  # disabled
+    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "banana")
+    assert A._pallas_min_seq() == 4096
+    monkeypatch.delenv("RELORA_TPU_PALLAS_MIN_SEQ")
+    assert A._pallas_min_seq() == 4096
+
+
 @pytest.mark.slow
 def test_against_hf_torch_llama():
     """Differential oracle: our forward vs transformers' torch Llama with

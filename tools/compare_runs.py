@@ -47,6 +47,7 @@ def main() -> None:
         nargs="+",
         help="name=dir pairs; the first run is the baseline for gaps",
     )
+    p.add_argument("--out", default="", help="also write the JSON summary here")
     args = p.parse_args()
 
     runs = []
@@ -88,6 +89,9 @@ def main() -> None:
                     (summary[name] - bfinal) / bfinal * 100, 3
                 )
     print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,214 @@
+"""Dryrun a production sharding at REAL tensor shapes on virtual CPU devices.
+
+BASELINE configs 3-5 (1B r=128 FSDP on v4-32; 1B magnitude-pruning; 7B r=256
+on v5p-64, frozen base sharded + LoRA replicated) can't run on this sandbox's
+single chip — but their *shardings* can: XLA's CPU backend carves one host
+into N virtual devices (``--xla_force_host_platform_device_count``), and the
+GSPMD partitioner sees exactly the shapes it would see on the pod.
+
+This tool jits the full sharded train step + the jitted merge at real
+hidden/vocab dims (layer count reduced — depth repeats the same sharded
+layer, so 2 scanned layers exercise every partition decision 32 would), then
+measures what actually landed on device 0 — bytes of frozen base, trainable
+params, and Adam moments, read from the live arrays' addressable shards —
+and asserts each against tools/plan_memory.plan()'s analytic prediction.
+
+    python tools/dryrun_at_shape.py --model llama_1b --rank 128 --mesh fsdp=16 \
+        --layers 2 --seq 256 --chip v4
+    python tools/dryrun_at_shape.py --model llama_7b --rank 256 \
+        --mesh fsdp=8,tensor=4 --layers 2 --seq 256 --chip v5p
+
+Reference configs: training_configs/1B_v1.0.yaml; BASELINE.json configs 3-5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama_1b")
+    p.add_argument("--rank", type=int, default=128)
+    p.add_argument("--mesh", default="fsdp=16")
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--micro-batch", type=int, default=0, help="0 = data*fsdp")
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--chip", default="v4")
+    p.add_argument("--magnitude-reset", action="store_true")
+    p.add_argument("--tolerance", type=float, default=0.06)
+    args = p.parse_args()
+
+    from tools.plan_memory import parse_mesh, plan
+
+    factors = parse_mesh(args.mesh)
+    n_devices = math.prod(factors.values())
+
+    # virtual devices must be configured before jax initializes
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={n_devices}"
+    # real-dim shards on few host cores serialize device threads; the CPU
+    # collective rendezvous hard-aborts at 40s by default — give the virtual
+    # pod time to arrive
+    if "collective" not in flags:
+        flags += (
+            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+            " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+            " --xla_cpu_collective_timeout_seconds=1200"
+        )
+    os.environ["XLA_FLAGS"] = flags.strip()
+    from relora_tpu.utils.logging import honor_platform_request
+
+    honor_platform_request()
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from relora_tpu.config.model import MODEL_ZOO
+    from relora_tpu.core.optim import build_optimizer, reset_optimizer_state
+    from relora_tpu.core.partition import partition
+    from relora_tpu.core.relora import (
+        LoraSpec,
+        frozen_param_mask,
+        merge_and_reinit,
+        trainable_param_mask,
+    )
+    from relora_tpu.models.llama import LlamaForCausalLM
+    from relora_tpu.models.params_util import init_params, logical_partition_specs
+    from relora_tpu.parallel.mesh import (
+        MeshSpec,
+        batch_sharding,
+        make_mesh,
+        param_shardings,
+        set_current_mesh,
+        shard_params,
+    )
+    from relora_tpu.train.state import TrainState
+    from relora_tpu.train.step import make_train_step
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, f"need {n_devices} devices, got {len(jax.devices())}"
+    mesh = make_mesh(
+        MeshSpec(
+            data=factors.get("data", 1),
+            fsdp=factors.get("fsdp", 1),
+            tensor=factors.get("tensor", 1),
+            sequence=factors.get("sequence", 1),
+        ),
+        devices=devices,
+    )
+    set_current_mesh(mesh)
+
+    cfg = dataclasses.replace(MODEL_ZOO[args.model], num_hidden_layers=args.layers)
+    spec = LoraSpec(r=args.rank, alpha=32, dropout=0.0)
+    model = LlamaForCausalLM(cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True)
+
+    batch_div = factors.get("data", 1) * factors.get("fsdp", 1)
+    micro = args.micro_batch or batch_div
+    sample = jnp.zeros((batch_div, 8 * factors.get("sequence", 1)), jnp.int32)
+    params = init_params(model, jax.random.PRNGKey(0), sample)
+    mask = trainable_param_mask(params)
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+
+    shardings = param_shardings(mesh, logical_partition_specs(model, sample))
+    params = shard_params(params, shardings)
+    with mesh:
+        opt_state = jax.jit(tx.init)(partition(params, mask)[0])
+    state = TrainState.create(params, opt_state)
+
+    step = jax.jit(make_train_step(model, tx, mask), donate_argnums=0)
+    batch = jax.device_put(
+        jax.random.randint(
+            jax.random.PRNGKey(1), (1, micro, args.seq), 0, cfg.vocab_size
+        ),
+        batch_sharding(mesh, seq_sharded=factors.get("sequence", 1) > 1),
+    )
+    state, metrics = step(state, batch, jax.random.PRNGKey(2))
+    loss = float(metrics["loss"])
+    assert math.isfinite(loss), f"non-finite loss {loss}"
+
+    # the defining ReLoRA ops, jitted over the same sharded tree at shape
+    merged = jax.jit(lambda p, k: merge_and_reinit(p, k, spec))(
+        state.params, jax.random.PRNGKey(3)
+    )
+    jax.block_until_ready(merged)
+    if args.magnitude_reset:
+        reset = jax.jit(
+            lambda s: reset_optimizer_state(s, mode="magnitude", ratio=0.9)
+        )(state.opt_state)
+        jax.block_until_ready(reset)
+
+    # --- measure what device 0 actually holds --------------------------
+    dev0 = devices[0]
+
+    def bytes_on_dev0(tree) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            for shard in leaf.addressable_shards:
+                if shard.device == dev0:
+                    total += shard.data.size * shard.data.dtype.itemsize
+        return total
+
+    frozen_mask = frozen_param_mask(state.params)
+    frozen_tree = jax.tree_util.tree_map(
+        lambda p, f: p if f else None, state.params, frozen_mask
+    )
+    trainable_tree = jax.tree_util.tree_map(
+        lambda p, f: None if f else p, state.params, frozen_mask
+    )
+    measured = {
+        "frozen_params": bytes_on_dev0(frozen_tree) / 1e9,
+        "trainable_params": bytes_on_dev0(trainable_tree) / 1e9,
+        "adam_moments": bytes_on_dev0(state.opt_state) / 1e9,
+    }
+
+    predicted = {
+        k: v / 1e9
+        for k, v in plan(
+            args.model,
+            rank=args.rank,
+            mesh=args.mesh,
+            micro_batch=micro,
+            seq=args.seq,
+            chip=args.chip,
+            layers=args.layers,
+        )["per_device_bytes"].items()
+    }
+
+    failures = []
+    for key, got in measured.items():
+        want = predicted[key]
+        rel = abs(got - want) / max(want, 1e-9)
+        if rel > args.tolerance:
+            failures.append(f"{key}: measured {got:.4f} GB vs planned {want:.4f} GB")
+    out = {
+        "model": args.model,
+        "mesh": args.mesh,
+        "layers": args.layers,
+        "loss": round(loss, 4),
+        "measured_dev0_gb": {k: round(v, 4) for k, v in measured.items()},
+        "planned_dev0_gb": {k: predicted[k] for k in measured},
+        "full_depth_plan_gb": plan(
+            args.model, rank=args.rank, mesh=args.mesh, chip=args.chip
+        )["per_device_gb"]["total"],
+        "ok": not failures,
+        "failures": failures,
+    }
+    print(json.dumps(out, indent=2))
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

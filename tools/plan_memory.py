@@ -21,11 +21,15 @@ data*fsdp (batch) and sequence (seq axis).
     python tools/plan_memory.py --model llama_7b --rank 256 --mesh fsdp=32,tensor=2 \
         --micro-batch 8 --seq 2048 --chip v5p
     python tools/plan_memory.py --model llama_1b --rank 128 --micro-batch 8 --seq 1024
+
+``plan()`` is importable (tools/dryrun_at_shape.py asserts live sharded-array
+sizes against it at real hidden/vocab dims).
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import math
 import os
@@ -36,26 +40,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 CHIP_HBM = {"v5e": 16e9, "v5p": 95e9, "v4": 32e9}
 
 
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--model", default="llama_1b")
-    p.add_argument("--rank", type=int, default=128, help="0 = full-rank training")
-    p.add_argument("--mesh", default="", help="e.g. fsdp=8,tensor=2 (default: single chip)")
-    p.add_argument("--micro-batch", type=int, default=8)
-    p.add_argument("--seq", type=int, default=1024)
-    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
-    p.add_argument("--quantize", default=None, choices=[None, "int8", "nf4"])
-    p.add_argument("--remat", default="full", choices=["full", "dots", "none"])
-    p.add_argument("--loss", default="dense", choices=["dense", "chunked"])
-    p.add_argument("--chip", default="v5e", choices=sorted(CHIP_HBM))
-    args = p.parse_args()
+def parse_mesh(mesh: str) -> dict:
+    factors = {}
+    if mesh:
+        for part in mesh.split(","):
+            k, v = part.split("=")
+            factors[k.strip()] = int(v)
+    return factors
 
-    # abstract-only tool: always run on CPU (eval_shape never touches a
-    # device, and waiting on a TPU tunnel to plan memory would be absurd)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    from relora_tpu.utils.logging import honor_platform_request
 
-    honor_platform_request()
+def plan(
+    model: str,
+    *,
+    rank: int = 128,
+    mesh: str = "",
+    micro_batch: int = 8,
+    seq: int = 1024,
+    dtype: str = "bf16",
+    quantize=None,
+    remat: str = "full",
+    loss: str = "dense",
+    chip: str = "v5e",
+    layers: int = 0,
+) -> dict:
+    """Analytic per-device memory plan.  ``layers`` > 0 overrides the model's
+    layer count (used by dryrun_at_shape to compare against a reduced-depth
+    live run at real hidden/vocab dims).  Caller is responsible for the JAX
+    platform (this only uses eval_shape — no device memory is touched)."""
     import jax
     import jax.numpy as jnp
 
@@ -65,11 +76,7 @@ def main() -> None:
     from relora_tpu.models.params_util import logical_partition_specs
     from relora_tpu.parallel.mesh import LOGICAL_RULES
 
-    mesh_factors = {}
-    if args.mesh:
-        for part in args.mesh.split(","):
-            k, v = part.split("=")
-            mesh_factors[k.strip()] = int(v)
+    mesh_factors = parse_mesh(mesh)
     n_devices = math.prod(mesh_factors.values()) if mesh_factors else 1
     rules = dict(LOGICAL_RULES)
 
@@ -86,13 +93,15 @@ def main() -> None:
                 div *= mesh_factors.get(m, 1)
         return div
 
-    cfg = MODEL_ZOO[args.model] if args.model in MODEL_ZOO else load_model_config(args.model)
-    spec = LoraSpec(r=args.rank, alpha=32, dropout=0.0) if args.rank else None
-    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    model = LlamaForCausalLM(cfg, lora=spec, dtype=dtype, scan_layers=True)
+    cfg = MODEL_ZOO[model] if model in MODEL_ZOO else load_model_config(model)
+    if layers:
+        cfg = dataclasses.replace(cfg, num_hidden_layers=layers)
+    spec = LoraSpec(r=rank, alpha=32, dropout=0.0) if rank else None
+    jdtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    mdl = LlamaForCausalLM(cfg, lora=spec, dtype=jdtype, scan_layers=True)
     sample = jnp.zeros((1, 8), jnp.int32)
-    abstract = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), sample))["params"]
-    specs = logical_partition_specs(model, sample)
+    abstract = jax.eval_shape(lambda: mdl.init(jax.random.PRNGKey(0), sample))["params"]
+    specs = logical_partition_specs(mdl, sample)
 
     import flax.linen as nn
 
@@ -102,7 +111,7 @@ def main() -> None:
     # everything trains except the frozen base kernels of LoRA-wrapped
     # Denses — embeddings/norms/head carry Adam state too, and only those
     # frozen kernels are ever quantized (ops/quant.py)
-    frozen_mask = frozen_param_mask(abstract) if args.rank else None
+    frozen_mask = frozen_param_mask(abstract) if rank else None
 
     # --- params + optimizer + grads -----------------------------------
     frozen_bytes = trainable_bytes = opt_bytes = grad_bytes = 0.0
@@ -123,29 +132,29 @@ def main() -> None:
         key = tuple(str(getattr(k, "key", k)) for k in path)
         div = shard_div(flat_specs.get(key))
         n = leaf.size / div
-        trainable = not flat_frozen.get(key, False) if args.rank else True
+        trainable = not flat_frozen.get(key, False) if rank else True
         # param storage dtype: params are stored f32 (master) except the
         # quantized frozen base
         if trainable:
             trainable_bytes += n * 4
             opt_bytes += n * 4 * 2  # adam mu+nu f32
             grad_bytes += n * 4
-        elif args.quantize == "int8":
+        elif quantize == "int8":
             frozen_bytes += n * (1 + 4 / 256)  # codes + per-channel scales
-        elif args.quantize == "nf4":
+        elif quantize == "nf4":
             frozen_bytes += n * (0.5 + 1 / 64 + 4 / 4096)  # nibbles + dq scales
         else:
             frozen_bytes += n * 4
     # --- activations ---------------------------------------------------
-    B, S, H, L = args.micro_batch, args.seq, cfg.hidden_size, cfg.num_hidden_layers
+    B, S, H, L = micro_batch, seq, cfg.hidden_size, cfg.num_hidden_layers
     batch_div = mesh_factors.get("data", 1) * mesh_factors.get("fsdp", 1)
     seq_div = mesh_factors.get("sequence", 1)
-    bytes_el = 2 if args.dtype == "bf16" else 4
+    bytes_el = 2 if dtype == "bf16" else 4
     tok = (B / batch_div) * (S / seq_div)
     heads = cfg.num_attention_heads / mesh_factors.get("tensor", 1)
-    if args.remat == "full":
+    if remat == "full":
         act = L * tok * H * bytes_el  # layer-boundary residual per layer
-    elif args.remat == "dots":
+    elif remat == "dots":
         # boundaries + saved matmul outputs (qkv, attn out, 3 mlp)
         inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
         per_layer = tok * (H * 5 + inter * 3) * bytes_el
@@ -156,12 +165,24 @@ def main() -> None:
             (B / batch_div) * heads * (S / seq_div) * S * 4
         )
         act = L * per_layer
-    logits = 0 if args.loss == "chunked" else tok * cfg.vocab_size * 4
+    logits = 0 if loss == "chunked" else tok * cfg.vocab_size * 4
     total = frozen_bytes + trainable_bytes + opt_bytes + grad_bytes + act + logits
-    hbm = CHIP_HBM[args.chip]
-    out = {
-        "model": args.model,
+    hbm = CHIP_HBM[chip]
+    return {
+        "model": model,
         "devices": n_devices,
+        # unrounded, for tools asserting live measurements against the plan
+        # (the _gb fields are display-rounded to 1 MB and can carry >10%
+        # relative rounding error on small components)
+        "per_device_bytes": {
+            "frozen_params": frozen_bytes,
+            "trainable_params": trainable_bytes,
+            "adam_moments": opt_bytes,
+            "grads": grad_bytes,
+            "activations": act,
+            "logits": logits,
+            "total": total,
+        },
         "per_device_gb": {
             "frozen_params": round(frozen_bytes / 1e9, 3),
             "trainable_params": round(trainable_bytes / 1e9, 3),
@@ -171,11 +192,50 @@ def main() -> None:
             "logits": round(logits / 1e9, 3),
             "total": round(total / 1e9, 3),
         },
-        "chip": args.chip,
+        "chip": chip,
         "hbm_gb": hbm / 1e9,
-        "fits": total < hbm * 0.9,  # leave 10% for XLA workspace
-        "headroom_gb": round((hbm - total) / 1e9, 2),
+        # budget = 0.9*HBM (10% reserved for XLA workspace); headroom is
+        # against the same budget so fits=false never shows positive headroom
+        "budget_gb": round(hbm * 0.9 / 1e9, 2),
+        "fits": total < hbm * 0.9,
+        "headroom_gb": round((hbm * 0.9 - total) / 1e9, 2),
     }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama_1b")
+    p.add_argument("--rank", type=int, default=128, help="0 = full-rank training")
+    p.add_argument("--mesh", default="", help="e.g. fsdp=8,tensor=2 (default: single chip)")
+    p.add_argument("--micro-batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--quantize", default=None, choices=[None, "int8", "nf4"])
+    p.add_argument("--remat", default="full", choices=["full", "dots", "none"])
+    p.add_argument("--loss", default="dense", choices=["dense", "chunked"])
+    p.add_argument("--chip", default="v5e", choices=sorted(CHIP_HBM))
+    p.add_argument("--layers", type=int, default=0, help="override layer count")
+    args = p.parse_args()
+
+    # abstract-only tool: always run on CPU (eval_shape never touches a
+    # device, and waiting on a TPU tunnel to plan memory would be absurd)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from relora_tpu.utils.logging import honor_platform_request
+
+    honor_platform_request()
+    out = plan(
+        args.model,
+        rank=args.rank,
+        mesh=args.mesh,
+        micro_batch=args.micro_batch,
+        seq=args.seq,
+        dtype=args.dtype,
+        quantize=args.quantize,
+        remat=args.remat,
+        loss=args.loss,
+        chip=args.chip,
+        layers=args.layers,
+    )
     print(json.dumps(out, indent=2))
 
 

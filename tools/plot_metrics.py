@@ -1,18 +1,39 @@
 """Training-run visualization from metrics.jsonl (the wandb-dashboard view,
-offline — loss/LR/throughput curves with merge/reset markers).
+offline).  Three modes, covering the reference's plotting notebooks:
 
-Covers the reference's loss-curve/debug notebook use cases in one CLI.
-
-Usage::
+``curves`` (default — notebook 07_plotting): loss/LR/throughput curves for
+one or more runs with merge/reset markers and optional smoothing::
 
     python tools/plot_metrics.py ckpts/relora [more_run_dirs...] --out curves.png
+    python tools/plot_metrics.py curves ckpts/relora ckpts/full --ema 0.98
+
+``scaling`` (notebook 03_scaling_laws_plotting): final loss vs trainable
+params (log-log) per run group, with a least-squares power-law fit
+``loss = a * params^b`` per group (full-rank vs ReLoRA, split on use_peft
+from each run's run_config.json)::
+
+    python tools/plot_metrics.py scaling ckpts/run_* --out scaling.png
+
+``lr`` (notebook 04_plot_lr): preview any supported schedule's LR curve
+without running anything — the schedules are the real ones from
+core/schedules.py, not a re-derivation::
+
+    python tools/plot_metrics.py lr --scheduler cosine_restarts --lr 2e-3 \
+        --num-training-steps 8000 --warmup-steps 250 --cycle-length 1000 \
+        --restart-warmup-steps 100 --out lr.png
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODES = ("curves", "scaling", "lr")
 
 
 def load_metrics(run_dir: str):
@@ -21,17 +42,29 @@ def load_metrics(run_dir: str):
     return [r for r in rows if "loss" in r and "update_step" in r]
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("run_dirs", nargs="+")
-    p.add_argument("--out", default="curves.png")
-    p.add_argument("--ema", type=float, default=0.0, help="EMA smoothing factor (0 = off)")
-    args = p.parse_args(argv)
+def load_run_config(run_dir: str) -> dict:
+    path = os.path.join(run_dir, "run_config.json")
+    if os.path.exists(path):
+        return json.load(open(path))
+    return {}
 
+
+def _mpl():
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
+
+    return plt
+
+
+def cmd_curves(argv) -> None:
+    p = argparse.ArgumentParser(prog="plot_metrics.py curves")
+    p.add_argument("run_dirs", nargs="+")
+    p.add_argument("--out", default="curves.png")
+    p.add_argument("--ema", type=float, default=0.0, help="EMA smoothing factor (0 = off)")
+    args = p.parse_args(argv)
+    plt = _mpl()
 
     fig, axes = plt.subplots(1, 3, figsize=(15, 4))
     for run_dir in args.run_dirs:
@@ -71,6 +104,123 @@ def main(argv=None):
     fig.tight_layout()
     fig.savefig(args.out, dpi=120)
     print(f"wrote {args.out}")
+
+
+def fit_power_law(xs, ys):
+    """Least-squares fit of loss = a * x^b in log-log space (no scipy in the
+    image; for positive data this is the standard linearization)."""
+    lx = [math.log(x) for x in xs]
+    ly = [math.log(y) for y in ys]
+    n = len(lx)
+    mx, my = sum(lx) / n, sum(ly) / n
+    sxx = sum((v - mx) ** 2 for v in lx)
+    if sxx == 0:
+        return math.exp(my), 0.0
+    b = sum((u - mx) * (v - my) for u, v in zip(lx, ly)) / sxx
+    a = math.exp(my - b * mx)
+    return a, b
+
+
+def final_eval_loss(rows) -> float:
+    """Last eval loss if the run recorded any, else min smoothed train loss."""
+    evals = [r for r in rows if r.get("eval_loss") is not None]
+    if evals:
+        return float(evals[-1]["eval_loss"])
+    tail = [r["loss"] for r in rows[-20:]]
+    return float(sum(tail) / len(tail))
+
+
+def cmd_scaling(argv) -> None:
+    p = argparse.ArgumentParser(prog="plot_metrics.py scaling")
+    p.add_argument("run_dirs", nargs="+")
+    p.add_argument("--out", default="scaling.png")
+    args = p.parse_args(argv)
+    plt = _mpl()
+
+    groups: dict = {}
+    for run_dir in args.run_dirs:
+        rows = load_metrics(run_dir)
+        cfg = load_run_config(run_dir)
+        if not rows or "trainable_params" not in cfg:
+            print(f"skipping {run_dir}: missing metrics or run_config.json trainable_params")
+            continue
+        group = "relora" if cfg.get("use_peft") else "full_rank"
+        groups.setdefault(group, []).append(
+            (float(cfg["trainable_params"]), final_eval_loss(rows), run_dir)
+        )
+
+    fig, ax = plt.subplots(figsize=(5.5, 5.5))
+    for group, pts in sorted(groups.items()):
+        xs = [p[0] for p in pts]
+        ys = [p[1] for p in pts]
+        ax.scatter(xs, ys, label=group)
+        if len(pts) >= 2:
+            a, b = fit_power_law(xs, ys)
+            grid = [min(xs) * (max(xs) / min(xs)) ** (i / 99) for i in range(100)]
+            ax.plot(grid, [a * x**b for x in grid], linestyle="--", alpha=0.7,
+                    label=f"{group}: {a:.2f}·x^{b:.3f}")
+            print(f"{group}: loss = {a:.4f} * params_M^{b:.4f}  ({len(pts)} runs)")
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("Trainable parameters (M)")
+    ax.set_ylabel("Loss")
+    ax.set_title("Scaling: loss vs trainable params")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=150)
+    print(f"wrote {args.out}")
+
+
+def cmd_lr(argv) -> None:
+    p = argparse.ArgumentParser(prog="plot_metrics.py lr")
+    p.add_argument("--scheduler", default="cosine_restarts")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--num-training-steps", type=int, default=8000)
+    p.add_argument("--warmup-steps", type=int, default=250)
+    p.add_argument("--min-lr-ratio", type=float, default=0.1)
+    p.add_argument("--cycle-length", type=int, default=1000)
+    p.add_argument("--restart-warmup-steps", type=int, default=100)
+    p.add_argument("--adjust-step", type=int, default=0)
+    p.add_argument("--out", default="lr.png")
+    args = p.parse_args(argv)
+
+    # analysis-only tool: always CPU (the sandbox env force-selects the TPU
+    # backend; evaluating a schedule needs no chip)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from relora_tpu.utils.logging import honor_platform_request
+
+    honor_platform_request()
+    from relora_tpu.core.schedules import make_schedule
+
+    sched = make_schedule(
+        args.scheduler,
+        lr=args.lr,
+        num_training_steps=args.num_training_steps,
+        warmup_steps=args.warmup_steps,
+        min_lr_ratio=args.min_lr_ratio,
+        cycle_length=args.cycle_length,
+        restart_warmup_steps=args.restart_warmup_steps,
+        adjust_step=args.adjust_step,
+    )
+    steps = list(range(args.num_training_steps))
+    values = [float(sched(s)) for s in steps]
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(8, 4))
+    ax.plot(steps, values)
+    ax.set_xlabel("update step")
+    ax.set_ylabel("learning rate")
+    ax.set_title(f"{args.scheduler} lr={args.lr}")
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}")
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    mode = "curves"
+    if argv and argv[0] in MODES:
+        mode = argv.pop(0)
+    {"curves": cmd_curves, "scaling": cmd_scaling, "lr": cmd_lr}[mode](argv)
 
 
 if __name__ == "__main__":
