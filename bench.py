@@ -70,7 +70,12 @@ _CFG = BENCH_CONFIGS[_CFG_NAME]
 def main() -> None:
     from relora_tpu.utils.benchlib import run_throughput_bench
 
-    res = run_throughput_bench(remat=True, rank=128, **_CFG)
+    # BENCH_REMAT_POLICY=dots selects the dots-saveable remat policy (keeps
+    # matmul outputs as residuals, recomputing only cheap elementwise ops);
+    # default "full" recomputes the whole layer.  Headline stays overridable
+    # so the measured-best policy can drive the driver-run number.
+    policy = os.environ.get("BENCH_REMAT_POLICY", "full")
+    res = run_throughput_bench(remat=True, remat_policy=policy, rank=128, **_CFG)
     print(
         json.dumps(
             {
@@ -86,6 +91,7 @@ def main() -> None:
                     "loss": res["loss"],
                     "device": res["device"],
                     "config": _CFG_NAME,
+                    "remat_policy": policy,
                 },
             }
         )

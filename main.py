@@ -44,6 +44,12 @@ def main(argv=None) -> dict:
 
     import jax
 
+    if cfg.prng_impl:
+        # e.g. 'rbg': hardware random bits instead of threefry — dropout
+        # bits per LoRA-wrapped linear are a measurable TPU cost (the
+        # bench_sweep --prng lever, promoted to a recipe knob)
+        jax.config.update("jax_default_prng_impl", cfg.prng_impl)
+
     if int(os.environ.get("RELORA_TPU_DISTRIBUTED", "0")):
         # multi-host pod: coordinator discovery via TPU metadata
         jax.distributed.initialize()

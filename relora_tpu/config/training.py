@@ -132,6 +132,12 @@ class TrainingConfig:
     skip_batches: Any = None
     seed: int = 0
     eval_tokens_during_training: int = 10_000_000  # torchrun_main.py:144
+    # end-of-run eval budget (reference hardcodes 100M, torchrun_main.py:984);
+    # configurable so CPU/scaled runs aren't forced through a full-split pass
+    final_eval_tokens: int = 100_000_000
+    # '' = jax default (threefry); 'rbg' = hardware RNG for dropout bits
+    # (cheaper on TPU; cross-host determinism caveats documented in jax)
+    prng_impl: str = ""
     nan_abort_fraction: float = 0.05  # torchrun_main.py:820
 
     # derived (set by finalize)

@@ -61,6 +61,33 @@ def build_optimizer(
     )
 
 
+def init_opt_state_sharded(
+    tx: optax.GradientTransformation, trainable: PyTree, mesh: jax.sharding.Mesh
+) -> PyTree:
+    """``tx.init`` with the Adam moments pinned to the trainables' shardings.
+
+    A bare ``jax.jit(tx.init)`` leaves output shardings to XLA, which
+    materializes every moment replicated until the first train step
+    re-shards them — a transient up-to-mesh-size× HBM spike (observed 4× on
+    adam moments in tools/dryrun_at_shape.py at 7B fsdp=8,tensor=4) that
+    OOMs exactly the pod-scale configs the sharding exists to fit.  Each
+    param-shaped state leaf inherits the matching param's sharding; scalar
+    counters (adam count, schedule count) are replicated.
+    """
+    replicated = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    param_shardings = jax.tree_util.tree_map(
+        lambda p: getattr(p, "sharding", None) or replicated, trainable
+    )
+    out_shardings = optax.tree_map_params(
+        tx,
+        lambda _, s: s,
+        jax.eval_shape(tx.init, trainable),
+        param_shardings,
+        transform_non_params=lambda _: replicated,
+    )
+    return jax.jit(tx.init, out_shardings=out_shardings)(trainable)
+
+
 def lora_label_tree(params: PyTree) -> PyTree:
     """'lora' / 'other' labels over a (trainable) param tree."""
     return jax.tree_util.tree_map_with_path(

@@ -28,7 +28,12 @@ import numpy as np
 
 from relora_tpu.config.model import ModelConfig, load_model_config
 from relora_tpu.config.training import TrainingConfig
-from relora_tpu.core.optim import build_optimizer, reset_optimizer_state, zeroed_fraction
+from relora_tpu.core.optim import (
+    build_optimizer,
+    init_opt_state_sharded,
+    reset_optimizer_state,
+    zeroed_fraction,
+)
 from relora_tpu.core.partition import partition
 from relora_tpu.core.relora import (
     LoraSpec,
@@ -261,7 +266,7 @@ class Trainer:
 
         with self.mesh:
             trainable, _ = partition(params, self.trainable_mask)
-            opt_state = jax.jit(self.tx.init)(trainable)
+            opt_state = init_opt_state_sharded(self.tx, trainable, self.mesh)
         self.state = TrainState.create(params, opt_state)
         self.state = self.state.replace(step=jnp.asarray(self.update_step, jnp.int32))
         self.state = self._normalize_placement(self.state)
@@ -551,7 +556,11 @@ class Trainer:
                 saved_at = self.update_step
 
             # ---- eval ----------------------------------------------------
-            if eval_iter_factory is not None and self.update_step % cfg.eval_every == 0:
+            if (
+                eval_iter_factory is not None
+                and cfg.eval_every > 0
+                and self.update_step % cfg.eval_every == 0
+            ):
                 eval_loss, eval_tokens = self.evaluate(
                     eval_iter_factory(), cfg.eval_tokens_during_training
                 )
@@ -644,7 +653,9 @@ class Trainer:
             "n_skipped": int(self.state.n_skipped),
         }
         if eval_iter_factory is not None:
-            final_loss, final_tokens = self.evaluate(eval_iter_factory(), target_tokens=100_000_000)
+            final_loss, final_tokens = self.evaluate(
+                eval_iter_factory(), target_tokens=cfg.final_eval_tokens
+            )
             self.metrics.log(
                 {"final_eval_loss": final_loss, "final_eval_tokens": final_tokens},
                 step=self.global_step,

@@ -24,6 +24,7 @@ LORA_R="${LORA_R:-128}"
 CYCLE="${CYCLE:-1000}"
 EVAL_EVERY="${EVAL_EVERY:-500}"
 EVAL_TOKENS="${EVAL_TOKENS:-500000}"
+FINAL_EVAL_TOKENS="${FINAL_EVAL_TOKENS:-100000000}"
 # run dirs are keyed by $MODEL so re-runs with a different MODEL (e.g. the
 # scaled-down CPU insurance pass) never reuse an incompatible warmup
 # checkpoint or autoresume from another model's branch dirs
@@ -43,6 +44,7 @@ EOF
 common=(--megatron_dataset_config "$WORK/data.yaml" --model_config "$MODEL"
         --batch_size "$BATCH" --total_batch_size "$BATCH" --max_length "$SEQ"
         --dtype bfloat16 --eval_every "$EVAL_EVERY" --eval_tokens_during_training "$EVAL_TOKENS"
+        --final_eval_tokens "$FINAL_EVAL_TOKENS"
         --keep_checkpoints 2 --seed 0)
 
 if [ ! -d "$WARMUP_DIR/model_$STEPS_WARMUP" ]; then

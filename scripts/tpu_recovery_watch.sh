@@ -57,6 +57,27 @@ sweep --remat --dropout 0 --label "remat full dropout0"
 sweep --remat --prng rbg --label "remat full rbg-prng"
 sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
 
+# 2b. if the dots policy beat the stage-1 headline, land a headline number
+# with the winning policy too (driver-format JSON, committed)
+if python - <<'EOF'
+import json, sys
+best_dots = 0.0
+try:
+    for line in open("bench_results/r3_sweep.jsonl"):
+        r = json.loads(line)
+        if "dots-policy" in r.get("label", ""):
+            best_dots = max(best_dots, r.get("mfu") or 0.0)
+    head = json.load(open("bench_results/BENCH_r3_local.json"))
+    sys.exit(0 if best_dots > head["detail"]["mfu"] else 1)
+except Exception:
+    sys.exit(1)
+EOF
+then
+  BENCH_REMAT_POLICY=dots timeout 1200 python bench.py \
+    > "$RES/BENCH_r3_local_dots.json" 2>/dev/null \
+    && commit "On-chip headline bench with dots remat policy" -- "$RES/BENCH_r3_local_dots.json"
+fi
+
 # 3. attention op-level A/B
 timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
   > "$RES/r3_attn.jsonl" 2>/tmp/attn_r3.err \

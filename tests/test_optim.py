@@ -217,3 +217,41 @@ def test_magnitude_reset_on_sharded_state_matches_unsharded():
         np.asarray(a.mu["layer"]["q_proj"]["lora_a"]),
         np.asarray(b.mu["layer"]["q_proj"]["lora_a"]),
     )
+
+
+@pytest.mark.usefixtures("devices")
+def test_init_opt_state_sharded_pins_moment_shardings():
+    """Adam moments must be born with the trainables' shardings, not
+    replicated-then-resharded (a transient mesh-size× HBM spike at init —
+    the thing init_opt_state_sharded exists to prevent)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from relora_tpu.core.optim import init_opt_state_sharded
+    from relora_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    params = make_trainable_tree()
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    shard = NamedSharding(mesh, P("fsdp"))
+    replicated = NamedSharding(mesh, P())
+
+    def shard_leaf(x):
+        if x.ndim >= 1 and x.shape[0] % 8 == 0:
+            return jax.device_put(x, shard)
+        return jax.device_put(x, replicated)
+
+    sharded_params = jax.tree_util.tree_map(shard_leaf, params)
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    with mesh:
+        state = init_opt_state_sharded(tx, sharded_params, mesh)
+
+    adam = find_adam_state(state)
+    for moments in (adam.mu, adam.nu):
+        flat_p = jax.tree_util.tree_leaves_with_path(sharded_params)
+        flat_m = jax.tree_util.tree_leaves_with_path(moments)
+        assert [k for k, _ in flat_p] == [k for k, _ in flat_m]
+        for (_, p), (path, m) in zip(flat_p, flat_m):
+            assert m.sharding == p.sharding, path
+    # scalar counters stay replicated
+    assert adam.count.sharding == replicated
+    # and the values are what tx.init would produce (zeros)
+    assert float(jnp.sum(jnp.abs(adam.mu["embed"]["embedding"]))) == 0.0

@@ -139,3 +139,28 @@ def test_evaluate_respects_token_target(tmp_path):
     loss_cap, n_cap = trainer.evaluate(eval_factory(), target_tokens=200)
     assert 200 <= n_cap <= 200 + 4 * 16
     assert np.isfinite(loss_full) and np.isfinite(loss_cap)
+
+
+@pytest.mark.slow
+def test_eval_every_zero_disables_midtraining_eval(tmp_path):
+    """eval_every=0 means no eval during training (and must not crash the
+    update-step modulo); the final eval still runs, capped by
+    final_eval_tokens."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512)
+    cfg = make_cfg(
+        tmp_path, num_training_steps=4, relora=None, use_peft=False,
+        scheduler="cosine", cycle_length=4, eval_every=0, save_every=100,
+        final_eval_tokens=256,
+    )
+    trainer = Trainer(cfg, model_cfg=TINY)
+    f, ef = make_iterators(cfg, trainer, data)
+    res = trainer.fit(f(), ef)
+    assert res["update_step"] == 4
+    lines = [json.loads(l) for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
+    assert not any("eval_loss" in l and "final_eval_loss" not in l for l in lines)
+    finals = [l for l in lines if "final_eval_loss" in l]
+    assert len(finals) == 1
+    # the 256-token cap bounds the final eval to cap + one microbatch
+    assert finals[0]["final_eval_tokens"] <= 256 + cfg.batch_size * cfg.max_length
