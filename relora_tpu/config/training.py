@@ -193,6 +193,11 @@ class TrainingConfig:
             self.relora = None
             self.lora_r = None
             self.force_keep_original = False
+        # relora=0 means disabled, exactly like None — normalize here so no
+        # consumer (merge cadence, reset cadence, scheduler cycle fallback,
+        # lora_only weight decision) has to remember the 0-vs-None convention
+        if self.relora == 0:
+            self.relora = None
 
         if self.total_batch_size is None:
             self.gradient_accumulation = self.gradient_accumulation or 1

@@ -549,6 +549,7 @@ class Trainer:
             # ---- save ----------------------------------------------------
             if (
                 cfg.save_dir
+                and cfg.save_every > 0
                 and self._local_updates > 1
                 and self.update_step % cfg.save_every == 0
             ):
@@ -571,7 +572,7 @@ class Trainer:
                 logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
 
             # ---- ReLoRA merge (torchrun_main.py:874-893) ----------------
-            relora_every = cfg.relora
+            relora_every = cfg.relora  # 0 normalized to None in finalize
             can_merge = relora_every is not None and (
                 self._resumed or self._local_updates >= relora_every
             )

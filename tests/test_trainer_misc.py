@@ -143,23 +143,29 @@ def test_evaluate_respects_token_target(tmp_path):
 
 @pytest.mark.slow
 def test_eval_every_zero_disables_midtraining_eval(tmp_path):
-    """eval_every=0 means no eval during training (and must not crash the
-    update-step modulo); the final eval still runs, capped by
-    final_eval_tokens."""
+    """0 means 'disabled' for every cadence knob (eval_every, save_every,
+    relora) — none may crash the update-step modulo; the final eval still
+    runs, capped by final_eval_tokens."""
     from relora_tpu.train.trainer import Trainer
 
     data = FakeTokens(n=512)
+    # relora=0 with cycle_length omitted: the scheduler cycle fallback and
+    # the reset cadence must both see the normalized None, not 0; 5 steps
+    # crosses the step a relora=4 run would reset at
     cfg = make_cfg(
-        tmp_path, num_training_steps=4, relora=None, use_peft=False,
-        scheduler="cosine", cycle_length=4, eval_every=0, save_every=100,
+        tmp_path, num_training_steps=5, relora=0, use_peft=True,
+        scheduler="cosine", cycle_length=None, eval_every=0, save_every=0,
         final_eval_tokens=256,
     )
+    assert cfg.relora is None
     trainer = Trainer(cfg, model_cfg=TINY)
     f, ef = make_iterators(cfg, trainer, data)
     res = trainer.fit(f(), ef)
-    assert res["update_step"] == 4
+    assert res["update_step"] == 5
+    assert trainer.n_lora_restarts == 0
     lines = [json.loads(l) for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
-    assert not any("eval_loss" in l and "final_eval_loss" not in l for l in lines)
+    # mid-training and final evals share the "final_eval_loss" key (reference
+    # wandb-schema parity), so exactly one entry proves no mid-training eval ran
     finals = [l for l in lines if "final_eval_loss" in l]
     assert len(finals) == 1
     # the 256-token cap bounds the final eval to cap + one microbatch
