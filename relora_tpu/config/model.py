@@ -146,6 +146,13 @@ MODEL_ZOO: dict[str, ModelConfig] = {
     "llama_7b": _llama(4096, 11008, 32, 32, seq=2048),
     # Pythia/GPT-NeoX sizes used by the reference's production recipe
     # (training_configs/1B_v1.0.yaml: EleutherAI/pythia-1b).
+    # pythia_14m is a dev size (llama_9m's role for the neox family —
+    # smoke tests and CI; not an EleutherAI release).
+    "pythia_14m": ModelConfig(
+        family="neox", vocab_size=50304, hidden_size=128, intermediate_size=512,
+        num_hidden_layers=4, num_attention_heads=4, max_sequence_length=2048,
+        rotary_pct=0.25, tie_word_embeddings=False,
+    ),
     "pythia_70m": ModelConfig(
         family="neox", vocab_size=50304, hidden_size=512, intermediate_size=2048,
         num_hidden_layers=6, num_attention_heads=8, max_sequence_length=2048,

@@ -62,7 +62,10 @@ def build_optimizer(
 
 
 def init_opt_state_sharded(
-    tx: optax.GradientTransformation, trainable: PyTree, mesh: jax.sharding.Mesh
+    tx: optax.GradientTransformation,
+    trainable: PyTree,
+    mesh: jax.sharding.Mesh,
+    shardings: Optional[PyTree] = None,
 ) -> PyTree:
     """``tx.init`` with the Adam moments pinned to the trainables' shardings.
 
@@ -73,16 +76,38 @@ def init_opt_state_sharded(
     OOMs exactly the pod-scale configs the sharding exists to fit.  Each
     param-shaped state leaf inherits the matching param's sharding; scalar
     counters (adam count, schedule count) are replicated.
+
+    ``shardings`` (a NamedSharding tree matching ``trainable``) is the
+    placement plan for leaves not already on ``mesh``: warm starts graft
+    uncommitted default-device leaves into an otherwise mesh-sharded tree,
+    and those must land on their planned shardings rather than force the
+    whole init through XLA-chosen (replicated) placement.  Without a plan,
+    a tree with any off-mesh leaf falls back to plain ``tx.init`` and the
+    caller's placement normalization.
     """
+    mesh_devices = mesh.devices.tolist()
+
+    def on_mesh(p) -> bool:
+        s = getattr(p, "sharding", None)
+        return isinstance(s, jax.sharding.NamedSharding) and s.mesh.devices.tolist() == mesh_devices
+
+    leaves = jax.tree_util.tree_leaves(trainable)
+    if not leaves or (shardings is None and not all(on_mesh(p) for p in leaves)):
+        return jax.jit(tx.init)(trainable)
+
+    if shardings is not None:
+        trainable = jax.tree_util.tree_map(
+            lambda p, s: p if on_mesh(p) else jax.device_put(p, s),
+            trainable,
+            shardings,
+        )
+
     replicated = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
-    param_shardings = jax.tree_util.tree_map(
-        lambda p: getattr(p, "sharding", None) or replicated, trainable
-    )
     out_shardings = optax.tree_map_params(
         tx,
         lambda _, s: s,
         jax.eval_shape(tx.init, trainable),
-        param_shardings,
+        jax.tree_util.tree_map(lambda p: p.sharding, trainable),
         transform_non_params=lambda _: replicated,
     )
     return jax.jit(tx.init, out_shardings=out_shardings)(trainable)

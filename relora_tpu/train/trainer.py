@@ -266,7 +266,12 @@ class Trainer:
 
         with self.mesh:
             trainable, _ = partition(params, self.trainable_mask)
-            opt_state = init_opt_state_sharded(self.tx, trainable, self.mesh)
+            opt_state = init_opt_state_sharded(
+                self.tx,
+                trainable,
+                self.mesh,
+                shardings=partition(self.shardings, self.trainable_mask)[0],
+            )
         self.state = TrainState.create(params, opt_state)
         self.state = self.state.replace(step=jnp.asarray(self.update_step, jnp.int32))
         self.state = self._normalize_placement(self.state)

@@ -18,15 +18,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_one(impl: str, B: int, S: int, N: int, H: int, steps: int) -> dict:
+def bench_one(
+    impl: str, B: int, S: int, N: int, H: int, steps: int, n_kv: int = 0
+) -> dict:
     import jax
     import jax.numpy as jnp
 
     from relora_tpu.ops.attention import dot_product_attention
 
-    q, k, v = (
-        jax.random.normal(jax.random.PRNGKey(i), (B, S, N, H), jnp.bfloat16)
-        for i in range(3)
+    n_kv = n_kv or N  # GQA: fewer K/V heads, exercised un-expanded
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, N, H), jnp.bfloat16)
+    k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (B, S, n_kv, H), jnp.bfloat16)
+        for i in range(1, 3)
     )
 
     def fwd_bwd(q, k, v):
@@ -51,6 +55,7 @@ def bench_one(impl: str, B: int, S: int, N: int, H: int, steps: int) -> dict:
     return {
         "impl": impl,
         "seq": S,
+        "kv_heads": n_kv,
         "ms": round(dt * 1e3, 2),
         "tflops": round(flops / dt / 1e12, 1),
     }
@@ -62,6 +67,7 @@ def main() -> None:
     p.add_argument("--impls", nargs="+", default=["xla", "pallas"])
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=0, help="0 = MHA (= --heads)")
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--steps", type=int, default=10)
     args = p.parse_args()
@@ -69,9 +75,17 @@ def main() -> None:
     for S in args.seqs:
         for impl in args.impls:
             try:
-                res = bench_one(impl, args.batch, S, args.heads, args.head_dim, args.steps)
+                res = bench_one(
+                    impl, args.batch, S, args.heads, args.head_dim, args.steps,
+                    n_kv=args.kv_heads,
+                )
             except Exception as e:  # OOM at long seq is itself a result
-                res = {"impl": impl, "seq": S, "error": str(e).split("\n")[0][:200]}
+                res = {
+                    "impl": impl,
+                    "seq": S,
+                    "kv_heads": args.kv_heads or args.heads,
+                    "error": str(e).split("\n")[0][:200],
+                }
             print(json.dumps(res))
             sys.stdout.flush()
 

@@ -62,7 +62,20 @@ python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_lengt
     --num_training_steps 40 --save_every 8 --save_dir "$WORK/relora" \
     --autoresume true
 
-echo "=== 5. analysis tools ==="
+echo "=== 5. pythia + ReLoRA under fsdp (reference README.dev.md:4-34 regime) ==="
+python main.py --megatron_dataset_config "$WORK/mega.yaml" --model_config pythia_14m \
+    --batch_size 1 --total_batch_size 8 --max_length 32 --fsdp_size 2 \
+    --warmup_steps 2 --eval_every 1000 --seed 0 \
+    --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --num_training_steps 16 --save_every 100 --save_dir "$WORK/pythia_relora"
+
+echo "=== 6. fp32 full-rank (reference README.dev.md:65-77 regime) ==="
+python main.py "${common[@]}" --lr 3e-3 --scheduler cosine --cycle_length 8 \
+    --dtype float32 --num_training_steps 8 --save_every 100 \
+    --save_dir "$WORK/full_fp32"
+
+echo "=== 7. analysis tools ==="
 python tools/analyze_rank.py --before "$WORK/relora/model_16" --after "$WORK/relora/model_40" | head -4
 python tools/inspect_optimizer.py "$WORK/relora/model_40" | head -3
 

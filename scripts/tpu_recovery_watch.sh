@@ -33,7 +33,11 @@ probe() {
 }
 
 sweep() { # sweep <args...>
-  timeout 1200 python scripts/bench_sweep.py --out "$RES/r3_sweep.jsonl" "$@" \
+  # each config is a FRESH program on-chip (policy/microbatch changes the
+  # HLO): remote compiles ran 5-15 min in past rounds, so give the compile
+  # room — the watchdog only bounds a wedged tunnel, not a slow compile
+  BENCH_WATCHDOG_SECS=1500 timeout 1800 python scripts/bench_sweep.py \
+      --out "$RES/r3_sweep.jsonl" "$@" \
     || echo "{\"error\": \"failed: $*\"}" >> "$RES/r3_sweep.jsonl"
   commit "On-chip sweep: $*" -- "$RES/r3_sweep.jsonl"
 }
@@ -46,7 +50,7 @@ done
 echo "tunnel UP $(date -u +%FT%TZ)"
 
 # 1. headline bench
-timeout 1200 python bench.py > "$RES/BENCH_r3_local.json" 2>/tmp/bench_r3.err \
+BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r3_local.json" 2>/tmp/bench_r3.err \
   && commit "On-chip headline bench (r3 local)" -- "$RES/BENCH_r3_local.json"
 
 # 2. lever sweep: the unmeasured big levers first
@@ -73,15 +77,18 @@ except Exception:
     sys.exit(1)
 EOF
 then
-  BENCH_REMAT_POLICY=dots timeout 1200 python bench.py \
+  BENCH_REMAT_POLICY=dots BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
     > "$RES/BENCH_r3_local_dots.json" 2>/dev/null \
     && commit "On-chip headline bench with dots remat policy" -- "$RES/BENCH_r3_local_dots.json"
 fi
 
-# 3. attention op-level A/B
+# 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
 timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
   > "$RES/r3_attn.jsonl" 2>/tmp/attn_r3.err \
   && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r3_attn.jsonl"
+timeout 2400 python scripts/bench_attention.py --seqs 4096 16384 --impls xla pallas \
+  --kv-heads 4 >> "$RES/r3_attn.jsonl" 2>>/tmp/attn_r3.err \
+  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r3_attn.jsonl"
 
 # 4. quantized-base benches
 sweep --remat --quantize int8 --label "remat int8-base"
@@ -89,9 +96,9 @@ sweep --remat --quantize nf4 --label "remat nf4-base"
 RELORA_TPU_PALLAS_QUANT=1 sweep --remat --quantize int8 --label "remat int8-base pallas-dequant"
 
 # 5. extra configs
-BENCH_CONFIG=llama_250m timeout 1200 python bench.py > "$RES/BENCH_r3_250m.json" 2>/dev/null \
+BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r3_250m.json" 2>/dev/null \
   && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r3_250m.json"
-BENCH_CONFIG=llama_1b_magnitude timeout 1200 python bench.py > "$RES/BENCH_r3_magnitude.json" 2>/dev/null \
+BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r3_magnitude.json" 2>/dev/null \
   && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r3_magnitude.json"
 
 # 6. loss parity (longest): 4000-step scaled config so both branches finish

@@ -255,3 +255,41 @@ def test_init_opt_state_sharded_pins_moment_shardings():
     assert adam.count.sharding == replicated
     # and the values are what tx.init would produce (zeros)
     assert float(jnp.sum(jnp.abs(adam.mu["embed"]["embedding"]))) == 0.0
+
+
+@pytest.mark.usefixtures("devices")
+def test_init_opt_state_sharded_mixed_tree_uses_plan():
+    """Warm starts graft uncommitted default-device leaves into a
+    mesh-sharded tree; with a placement plan the moments must still be born
+    on their planned shardings (not fall back to XLA-placed init)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from relora_tpu.core.optim import init_opt_state_sharded
+    from relora_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    params = make_trainable_tree()
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    shard = NamedSharding(mesh, P("fsdp"))
+    replicated = NamedSharding(mesh, P())
+
+    def plan_for(x):
+        return shard if (x.ndim >= 1 and x.shape[0] % 8 == 0) else replicated
+
+    plan = jax.tree_util.tree_map(plan_for, params)
+    sharded = jax.tree_util.tree_map(jax.device_put, params, plan)
+    # graft: replace the embedding with a fresh uncommitted default-device
+    # array (what hf_compat.graft_base_weights produces on warm start)
+    sharded["embed"]["embedding"] = jnp.asarray(
+        np.asarray(params["embed"]["embedding"])
+    )
+    tx = build_optimizer(schedule=lambda s: 1e-3)
+    with mesh:
+        state = init_opt_state_sharded(tx, sharded, mesh, shardings=plan)
+
+    adam = find_adam_state(state)
+    for moments in (adam.mu, adam.nu):
+        for (path, m), (_, s) in zip(
+            jax.tree_util.tree_leaves_with_path(moments),
+            jax.tree_util.tree_leaves_with_path(plan),
+        ):
+            assert m.sharding == s, path
