@@ -25,19 +25,28 @@ CYCLE="${CYCLE:-1000}"
 EVAL_EVERY="${EVAL_EVERY:-500}"
 EVAL_TOKENS="${EVAL_TOKENS:-500000}"
 FINAL_EVAL_TOKENS="${FINAL_EVAL_TOKENS:-100000000}"
-# run dirs are keyed by $MODEL so re-runs with a different MODEL (e.g. the
-# scaled-down CPU insurance pass) never reuse an incompatible warmup
-# checkpoint or autoresume from another model's branch dirs
-WARMUP_DIR="$WORK/warmup_$MODEL"
-FULL_DIR="$WORK/full_rank_$MODEL"
-RELORA_DIR="$WORK/relora_$MODEL"
+# SEED seeds init, data order, and LoRA re-inits — run a second seed (with
+# its own WORK dir) to check the parity gap is robust, not a seed artifact
+SEED="${SEED:-0}"
+LR_WARMUP="${LR_WARMUP:-250}"
+RESTART_WARMUP="${RESTART_WARMUP:-100}"
+# run dirs are keyed by $MODEL (and by seed for SEED!=0) so re-runs with a
+# different MODEL or SEED never reuse an incompatible warmup checkpoint or
+# silently autoresume another run's finished branches — without the seed
+# key, `SEED=1` in a reused WORK dir would skip every stage and relabel
+# the seed-0 result as a replication
+KEY="$MODEL"
+[ "$SEED" != "0" ] && KEY="${MODEL}_s${SEED}"
+WARMUP_DIR="$WORK/warmup_$KEY"
+FULL_DIR="$WORK/full_rank_$KEY"
+RELORA_DIR="$WORK/relora_$KEY"
 mkdir -p "$WORK"
 
 cat > "$WORK/data.yaml" <<EOF
 data_path: $CORPUS
 split: "95,4,1"
 seq_length: $SEQ
-seed: 0
+seed: $SEED
 data_impl: mmap
 EOF
 
@@ -45,12 +54,12 @@ common=(--megatron_dataset_config "$WORK/data.yaml" --model_config "$MODEL"
         --batch_size "$BATCH" --total_batch_size "$BATCH" --max_length "$SEQ"
         --dtype bfloat16 --eval_every "$EVAL_EVERY" --eval_tokens_during_training "$EVAL_TOKENS"
         --final_eval_tokens "$FINAL_EVAL_TOKENS"
-        --keep_checkpoints 2 --seed 0)
+        --keep_checkpoints 2 --seed "$SEED")
 
 if [ ! -d "$WARMUP_DIR/model_$STEPS_WARMUP" ]; then
   echo "=== stage 1: shared full-rank warmup ($STEPS_WARMUP steps) ==="
   python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
-      --warmup_steps 250 --cycle_length "$STEPS_WARMUP" --min_lr_ratio 0.9 \
+      --warmup_steps "$LR_WARMUP" --cycle_length "$STEPS_WARMUP" --min_lr_ratio 0.9 \
       --num_training_steps "$STEPS_WARMUP" --save_every "$STEPS_WARMUP" \
       --save_dir "$WARMUP_DIR"
 fi
@@ -58,7 +67,7 @@ fi
 echo "=== stage 2a: full-rank branch (to $STEPS_TOTAL steps) ==="
 # warm-started schedules run over the REMAINING steps (trainer.py:242-251)
 python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
-    --warmup_steps 250 --cycle_length "$((STEPS_TOTAL - STEPS_WARMUP))" \
+    --warmup_steps "$LR_WARMUP" --cycle_length "$((STEPS_TOTAL - STEPS_WARMUP))" \
     --warmed_up_model "$WARMUP_DIR/model_$STEPS_WARMUP" \
     --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
     --save_dir "$FULL_DIR" --autoresume true
@@ -66,7 +75,7 @@ python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
 echo "=== stage 2b: ReLoRA branch (to $STEPS_TOTAL steps) ==="
 python main.py "${common[@]}" --lr 2e-3 --use_peft true --lora_r "$LORA_R" \
     --relora "$CYCLE" --cycle_length "$CYCLE" --scheduler cosine_restarts \
-    --warmup_steps 250 --restart_warmup_steps 100 \
+    --warmup_steps "$LR_WARMUP" --restart_warmup_steps "$RESTART_WARMUP" \
     --reset_optimizer_on_relora true \
     --warmed_up_model "$WARMUP_DIR/model_$STEPS_WARMUP" \
     --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
