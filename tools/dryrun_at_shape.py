@@ -42,6 +42,16 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=256)
     p.add_argument("--chip", default="v4")
     p.add_argument("--magnitude-reset", action="store_true")
+    p.add_argument(
+        "--attn",
+        default="auto",
+        # ring_zigzag is deliberately absent: it needs the train step's
+        # zigzag input permutation (train/step.py), which this tool
+        # doesn't wire — accepting it would silently compute garbage
+        choices=["auto", "xla", "pallas", "ring", "ulysses", "naive"],
+        help="attention impl; 'ring' exercises the sequence-parallel "
+        "shard_map path at shape (requires a sequence axis in --mesh)",
+    )
     p.add_argument("--tolerance", type=float, default=0.06)
     args = p.parse_args()
 
@@ -114,7 +124,10 @@ def main() -> None:
 
     cfg = dataclasses.replace(MODEL_ZOO[args.model], num_hidden_layers=args.layers)
     spec = LoraSpec(r=args.rank, alpha=32, dropout=0.0)
-    model = LlamaForCausalLM(cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True)
+    model = LlamaForCausalLM(
+        cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True,
+        attention_impl=args.attn,
+    )
 
     batch_div = factors.get("data", 1) * factors.get("fsdp", 1)
     micro = args.micro_batch or batch_div
@@ -210,6 +223,8 @@ def main() -> None:
         "model": args.model,
         "mesh": args.mesh,
         "layers": args.layers,
+        "seq": args.seq,
+        "attn": args.attn,
         "loss": round(loss, 4),
         "measured_dev0_gb": {k: round(v, 4) for k, v in measured.items()},
         "after_step_dev0_gb": {k: round(v, 4) for k, v in after_step.items()},
