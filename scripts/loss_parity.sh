@@ -30,6 +30,12 @@ FINAL_EVAL_TOKENS="${FINAL_EVAL_TOKENS:-100000000}"
 SEED="${SEED:-0}"
 LR_WARMUP="${LR_WARMUP:-250}"
 RESTART_WARMUP="${RESTART_WARMUP:-100}"
+# OPT_PRUNE: empty or 0 = zero reset (reference default); a ratio in
+# (0, 1) switches the ReLoRA branch to magnitude-pruning resets.  "0" is
+# folded into the default so it cannot silently select a third behavior
+# (no reset at all) via --reset_optimizer_on_relora false.
+OPT_PRUNE="${OPT_PRUNE:-}"
+[ "$OPT_PRUNE" = "0" ] && OPT_PRUNE=""
 # run dirs are keyed by $MODEL (and by seed for SEED!=0) so re-runs with a
 # different MODEL or SEED never reuse an incompatible warmup checkpoint or
 # silently autoresume another run's finished branches — without the seed
@@ -37,9 +43,19 @@ RESTART_WARMUP="${RESTART_WARMUP:-100}"
 # the seed-0 result as a replication
 KEY="$MODEL"
 [ "$SEED" != "0" ] && KEY="${MODEL}_s${SEED}"
+# The ReLoRA branch (and the comparison output) additionally key on the
+# reset mode: an OPT_PRUNE re-run in a reused WORK dir must not autoresume
+# the zero-reset branch and relabel its curve.  The warmup and full-rank
+# branches are mode-independent and stay shared across variants.
+RKEY="$KEY"
+COMPARE_OUT="$WORK/compare.json"
+if [ -n "$OPT_PRUNE" ]; then
+  RKEY="${KEY}_mag${OPT_PRUNE}"
+  COMPARE_OUT="$WORK/compare_mag${OPT_PRUNE}.json"
+fi
 WARMUP_DIR="$WORK/warmup_$KEY"
 FULL_DIR="$WORK/full_rank_$KEY"
-RELORA_DIR="$WORK/relora_$KEY"
+RELORA_DIR="$WORK/relora_$RKEY"
 mkdir -p "$WORK"
 
 cat > "$WORK/data.yaml" <<EOF
@@ -73,14 +89,19 @@ python main.py "${common[@]}" --lr 1e-3 --scheduler cosine \
     --save_dir "$FULL_DIR" --autoresume true
 
 echo "=== stage 2b: ReLoRA branch (to $STEPS_TOTAL steps) ==="
+if [ -n "$OPT_PRUNE" ]; then
+  reset_flags=(--reset_optimizer_on_relora false --optimizer_magnitude_pruning "$OPT_PRUNE")
+else
+  reset_flags=(--reset_optimizer_on_relora true)
+fi
 python main.py "${common[@]}" --lr 2e-3 --use_peft true --lora_r "$LORA_R" \
     --relora "$CYCLE" --cycle_length "$CYCLE" --scheduler cosine_restarts \
     --warmup_steps "$LR_WARMUP" --restart_warmup_steps "$RESTART_WARMUP" \
-    --reset_optimizer_on_relora true \
+    "${reset_flags[@]}" \
     --warmed_up_model "$WARMUP_DIR/model_$STEPS_WARMUP" \
     --num_training_steps "$STEPS_TOTAL" --save_every 4000 \
     --save_dir "$RELORA_DIR" --autoresume true
 
 echo "=== results ==="
 python tools/compare_runs.py full_rank="$FULL_DIR" relora="$RELORA_DIR" \
-    --out "$WORK/compare.json"
+    --out "$COMPARE_OUT"
