@@ -55,16 +55,20 @@ def _grouped_equal_heads_call(q, k, v, equal_heads_fn) -> jax.Array:
 
 def _pallas_min_seq() -> int:
     """Sequence length at/above which impl='auto' prefers the pallas flash
-    kernel on TPU.  Default 4096 is provisional (XLA wins at 1024, measured;
-    the crossover awaits scripts/bench_attention.py on-chip).  0 disables."""
+    kernel on TPU.  Disabled unless RELORA_TPU_PALLAS_MIN_SEQ is set: the
+    only recorded A/B has XLA beating pallas by 5% at seq 1024 on the v5e
+    (BASELINE.md r2), so until scripts/bench_attention.py has measured the
+    crossover on-chip, auto stays on the XLA fused path and the pallas
+    dispatch is explicit opt-in.  0 (or unset) disables."""
     import os
 
-    raw = os.environ.get("RELORA_TPU_PALLAS_MIN_SEQ", "4096")
+    _DISABLED = 1 << 62
+    raw = os.environ.get("RELORA_TPU_PALLAS_MIN_SEQ", "")
     try:
         val = int(raw)
     except ValueError:
-        return 4096
-    return val if val > 0 else 1 << 62
+        return _DISABLED
+    return val if val > 0 else _DISABLED
 
 
 def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
@@ -141,12 +145,12 @@ def dot_product_attention(
 ) -> jax.Array:
     """Causal SDPA over ``(B, S, N, H)`` tensors.
 
-    ``impl='auto'`` resolves by sequence length: the XLA fused path (which
-    beat the pallas kernel by 5% at seq 1024 on the v5e, BASELINE.md r2) up
-    to ``PALLAS_MIN_SEQ``-1, the pallas flash kernel above — on TPU only.
-    The threshold is provisional pending the op-level A/B at 1k/4k/16k
-    (scripts/bench_attention.py); override with RELORA_TPU_PALLAS_MIN_SEQ
-    (0 disables the pallas dispatch entirely).
+    ``impl='auto'`` resolves to the XLA fused path (which beat the pallas
+    kernel by 5% at seq 1024 on the v5e, BASELINE.md r2).  Setting
+    ``RELORA_TPU_PALLAS_MIN_SEQ=N`` opts in to the pallas flash kernel for
+    seq >= N on TPU; until the op-level A/B at 1k/4k/16k
+    (scripts/bench_attention.py) has measured a crossover on-chip there is
+    no default threshold.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5

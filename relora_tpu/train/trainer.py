@@ -720,13 +720,30 @@ class Trainer:
 
         for arr in eval_iter:
             pending.append(self._eval_step(self.state.params, self.device_batch(arr)))
-            expected_tokens += int(np.asarray(arr).size)
+            # shifted-label estimate: the loss sees at most seq-1 targets per
+            # row (fewer with padding), so batch*(seq-1) upper-bounds the
+            # loss-token count far tighter than raw batch size.  The device
+            # n_tokens is a global sum over hosts, each feeding an
+            # equally-shaped local slice, so scale by process_count to keep
+            # the host-side estimate an upper bound on the global count.
+            shape = np.asarray(arr).shape
+            expected_tokens += (
+                int(shape[0] * max(shape[-1] - 1, 1)) * jax.process_count()
+            )
             if len(pending) >= max(sync_every, 1) or (
                 target_tokens > 0 and expected_tokens >= target_tokens
             ):
                 drain()
-                if target_tokens > 0 and n_tokens >= target_tokens:
-                    break
+                if target_tokens > 0:
+                    if n_tokens >= target_tokens:
+                        break
+                    # re-arm the early-drain trigger from the true count:
+                    # with padded data the host estimate overshoots, and
+                    # without this reset every subsequent batch would drain
+                    # (one device round trip each) until the real count
+                    # caught up — exactly the per-batch sync sync_every
+                    # exists to avoid
+                    expected_tokens = int(n_tokens)
         drain()
         return loss_sum / max(n_tokens, 1.0), n_tokens
 

@@ -91,8 +91,11 @@ def run_throughput_bench(
     rng = jax.random.PRNGKey(2)
 
     # always at least one untimed step: primes the compile cache and binds
-    # `metrics` for the pre-measure sync even when warmup_steps == 0
-    for i in range(max(warmup_steps, 1)):
+    # `metrics` for the pre-measure sync even when warmup_steps == 0 — the
+    # result dict reports warmup_steps_effective so a --warmup 0 sweep can
+    # see the floor was applied rather than misattribute the measurement
+    warmup_steps_effective = max(warmup_steps, 1)
+    for i in range(warmup_steps_effective):
         state, metrics = step(state, batch, jax.random.fold_in(rng, i))
     if magnitude_reset:
         from relora_tpu.core.optim import reset_optimizer_state
@@ -133,6 +136,7 @@ def run_throughput_bench(
         "mfu": round(mfu, 4),
         "step_time_s": round(dt / measure_steps, 4),
         "tokens_per_update": tokens_per_update,
+        "warmup_steps_effective": warmup_steps_effective,
         "loss": final_loss,
         "hbm_peak_gb": hbm_peak_gb,
         "device": str(jax.devices()[0]),

@@ -43,16 +43,22 @@ OPT_PRUNE="${OPT_PRUNE:-}"
 # the seed-0 result as a replication
 KEY="$MODEL"
 [ "$SEED" != "0" ] && KEY="${MODEL}_s${SEED}"
-# The ReLoRA branch (and the comparison output) additionally key on the
-# reset mode: an OPT_PRUNE re-run in a reused WORK dir must not autoresume
-# the zero-reset branch and relabel its curve.  The warmup and full-rank
-# branches are mode-independent and stay shared across variants.
-RKEY="$KEY"
-COMPARE_OUT="$WORK/compare.json"
-if [ -n "$OPT_PRUNE" ]; then
-  RKEY="${KEY}_mag${OPT_PRUNE}"
-  COMPARE_OUT="$WORK/compare_mag${OPT_PRUNE}.json"
-fi
+# The ReLoRA branch (and the comparison output) additionally key on every
+# knob that changes that branch's trajectory — reset mode, LoRA rank,
+# cycle length: a re-run with any of these changed in a reused WORK dir
+# must not autoresume the previous variant's checkpoints and relabel its
+# curve.  The warmup and full-rank branches are independent of all three
+# and stay shared across variants.
+SUFFIX=""
+[ "$LORA_R" != "128" ] && SUFFIX="${SUFFIX}_r${LORA_R}"
+[ "$CYCLE" != "1000" ] && SUFFIX="${SUFFIX}_c${CYCLE}"
+[ "$RESTART_WARMUP" != "100" ] && SUFFIX="${SUFFIX}_rw${RESTART_WARMUP}"
+[ -n "$OPT_PRUNE" ] && SUFFIX="${SUFFIX}_mag${OPT_PRUNE}"
+RKEY="${KEY}${SUFFIX}"
+# keyed by RKEY (MODEL/SEED + variant suffix), not SUFFIX alone: runs that
+# share a WORK dir across models/seeds must not overwrite each other's
+# comparison output
+COMPARE_OUT="$WORK/compare_${RKEY}.json"
 WARMUP_DIR="$WORK/warmup_$KEY"
 FULL_DIR="$WORK/full_rank_$KEY"
 RELORA_DIR="$WORK/relora_$RKEY"
