@@ -237,9 +237,7 @@ def set_schedule_count(opt_state: PyTree, count: int) -> PyTree:
     return walk(opt_state)
 
 
-def zeroed_fraction(opt_state: PyTree) -> jax.Array:
-    """Fraction of zeros across all Adam moments (parity logging:
-    training_utils.py:363-364)."""
+def _zeroed_fraction_impl(opt_state: PyTree) -> jax.Array:
     zeros = jnp.asarray(0.0)
     total = jnp.asarray(0.0)
 
@@ -259,6 +257,24 @@ def zeroed_fraction(opt_state: PyTree) -> jax.Array:
 
     walk(opt_state)
     return zeros / (1e-7 + total)
+
+
+_zeroed_fraction_jit = jax.jit(_zeroed_fraction_impl)
+
+
+def zeroed_fraction(opt_state: PyTree) -> jax.Array:
+    """Fraction of zeros across all Adam moments (parity logging:
+    training_utils.py:363-364).
+
+    Jitted into ONE program on purpose: eagerly summing each moment leaf of
+    a multi-process-sharded opt_state dispatches dozens of tiny collective
+    programs, and interleaving those with the train step's collectives
+    deadlocked a real 2-process fsdp run (each process wedged in a
+    different program at the first merge+reset boundary).  A single
+    compiled reduction is one collective both processes dispatch at the
+    same point in the step sequence.
+    """
+    return _zeroed_fraction_jit(opt_state)
 
 
 def global_norm(tree: PyTree) -> jax.Array:

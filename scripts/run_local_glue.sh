@@ -1,0 +1,89 @@
+#!/usr/bin/env bash
+# Downstream-eval loop on REAL pretrained checkpoints (round-4 verdict #4):
+# fine-tune the recorded loss-parity checkpoints on the local GLUE-format
+# tasks (tools/build_local_glue.py; GLUE itself needs hub access this
+# sandbox doesn't have) and aggregate the metrics into one JSON table.
+#
+# Three backbones per task:
+#   relora  — the ReLoRA parity branch checkpoint (LoRA merged at load)
+#   full    — the full-rank parity branch checkpoint
+#   scratch — random init (no --checkpoint): the pretraining-helps control
+#
+# Usage: bash scripts/run_local_glue.sh [OUT_JSON]
+#   env: TASKS_DIR=/tmp/local_glue  CKPT_RELORA=...  CKPT_FULL=...
+#        MODEL=llama_9m  TOKENIZER=/tmp/corpus/local400.tokenizer.json
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_JSON="${1:-bench_results/r4_glue.json}"
+TASKS_DIR="${TASKS_DIR:-/tmp/local_glue}"
+CKPT_RELORA="${CKPT_RELORA:-/tmp/loss_parity_cpu/relora_llama_9m/model_1450}"
+CKPT_FULL="${CKPT_FULL:-/tmp/loss_parity_cpu/full_rank_llama_9m/model_1450}"
+MODEL="${MODEL:-llama_9m}"
+TOKENIZER="${TOKENIZER:-/tmp/corpus/local400.tokenizer.json}"
+WORK="${WORK:-/tmp/local_glue_runs}"
+EPOCHS="${EPOCHS:-3}"
+BATCH="${BATCH:-16}"
+LR="${LR:-5e-5}"
+MAXLEN="${MAXLEN:-128}"
+TASKS="${TASKS:-locdoc locpair locorder}"
+
+mkdir -p "$WORK" "$(dirname "$OUT_JSON")"
+
+run_one() { # run_one <task> <backbone-name> <checkpoint-or-->
+  local task="$1" name="$2" ckpt="$3"
+  local out="$WORK/${task}_${name}"
+  if [ -f "$out/all_results.json" ]; then
+    echo "skip $task/$name (already done)"
+    return 0
+  fi
+  local ckpt_flags=()
+  [ "$ckpt" != "-" ] && ckpt_flags=(--checkpoint "$ckpt")
+  # failures leave NO all_results.json (a FAILED marker instead), so the
+  # skip-if-exists check retries them on the next invocation and the
+  # aggregator reports null rather than a sentinel posing as metrics
+  if python run_glue.py --task_name "$task" \
+    --train_file "$TASKS_DIR/$task/train.csv" \
+    --validation_file "$TASKS_DIR/$task/validation.csv" \
+    --model_config "$MODEL" "${ckpt_flags[@]}" \
+    --tokenizer "$TOKENIZER" \
+    --lr "$LR" --batch_size "$BATCH" --num_epochs "$EPOCHS" \
+    --max_seq_length "$MAXLEN" --seed 0 \
+    --output_dir "$out" --overwrite_output_dir true; then
+    rm -f "$out/FAILED"
+  else
+    mkdir -p "$out"; echo "exit=$? $(date -u +%FT%TZ)" >> "$out/FAILED"
+  fi
+}
+
+for task in $TASKS; do
+  run_one "$task" relora "$CKPT_RELORA"
+  run_one "$task" full "$CKPT_FULL"
+  run_one "$task" scratch -
+done
+
+TASKS_DIR="$TASKS_DIR" CKPT_RELORA="$CKPT_RELORA" CKPT_FULL="$CKPT_FULL" \
+python - "$OUT_JSON" "$WORK" "$TASKS" <<'EOF'
+import json, os, sys
+out_json, work, tasks = sys.argv[1], sys.argv[2], sys.argv[3].split()
+tasks_dir = os.environ["TASKS_DIR"]
+table = {}
+for task in tasks:
+    table[task] = {}
+    for name in ("relora", "full", "scratch"):
+        p = os.path.join(work, f"{task}_{name}", "all_results.json")
+        table[task][name] = json.load(open(p)) if os.path.exists(p) else None
+meta_path = os.path.join(tasks_dir, "meta.json")
+result = {
+    "experiment": "local GLUE-format downstream eval of recorded parity checkpoints",
+    "tasks_meta": json.load(open(meta_path)) if os.path.exists(meta_path) else None,
+    "backbones": {
+        "relora": os.environ["CKPT_RELORA"],
+        "full": os.environ["CKPT_FULL"],
+        "scratch": "random init (no checkpoint)",
+    },
+    "results": table,
+}
+json.dump(result, open(out_json, "w"), indent=2)
+print(json.dumps(table, indent=2))
+EOF

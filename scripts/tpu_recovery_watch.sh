@@ -1,16 +1,20 @@
 #!/bin/bash
-# TPU-tunnel recovery watcher (bench insurance).
+# TPU-tunnel recovery watcher (bench insurance), round-4 priorities.
 #
-# The sandbox's one-chip TPU tunnel has died mid-round in every round so far;
-# this watcher probes it and, the moment it answers, runs the queued on-chip
-# work in strict priority order — committing each stage's artifacts to git
-# immediately so a second outage can't erase a completed measurement:
+# The sandbox's one-chip TPU tunnel has died mid-round in every round so far
+# (round 3: down the whole round); this watcher probes it and, the moment it
+# answers, runs the queued on-chip work in strict priority order — committing
+# each stage's artifacts to git immediately so a second outage can't erase a
+# completed measurement:
 #   1. bench.py (the driver's headline number)        -> bench_results/
-#   2. remat/microbatch lever sweep (bench_sweep.py)  -> bench_results/r3_sweep.jsonl
-#   3. attention op-level A/B (bench_attention.py)    -> bench_results/r3_attn.jsonl
-#   4. quantized-base benches (int8 / nf4)            -> bench_results/r3_sweep.jsonl
+#   2. remat/microbatch lever sweep (bench_sweep.py)  -> bench_results/r4_sweep.jsonl
+#      + re-run the headline with the dots policy if it wins
+#   3. attention op-level A/B (bench_attention.py)    -> bench_results/r4_attn.jsonl
+#   4. quantized-base benches (int8 / nf4)            -> bench_results/r4_sweep.jsonl
 #   5. extra bench configs (250m, magnitude)          -> bench_results/
-#   6. loss-parity experiment (longest; CPU fallback exists)
+#   6. loss-parity at llama_35m, 1000-step cycles (longest), then the
+#      magnitude-pruning variant at the same cycle length (shares warmup +
+#      full-rank branches)
 #
 # Usage: nohup bash scripts/tpu_recovery_watch.sh > /tmp/tpu_watch.log 2>&1 &
 set -u
@@ -37,9 +41,9 @@ sweep() { # sweep <args...>
   # HLO): remote compiles ran 5-15 min in past rounds, so give the compile
   # room — the watchdog only bounds a wedged tunnel, not a slow compile
   BENCH_WATCHDOG_SECS=1500 timeout 1800 python scripts/bench_sweep.py \
-      --out "$RES/r3_sweep.jsonl" "$@" \
-    || echo "{\"error\": \"failed: $*\"}" >> "$RES/r3_sweep.jsonl"
-  commit "On-chip sweep: $*" -- "$RES/r3_sweep.jsonl"
+      --out "$RES/r4_sweep.jsonl" "$@" \
+    || echo "{\"error\": \"failed: $*\"}" >> "$RES/r4_sweep.jsonl"
+  commit "On-chip sweep: $*" -- "$RES/r4_sweep.jsonl"
 }
 
 echo "watcher start $(date -u +%FT%TZ)"
@@ -50,8 +54,8 @@ done
 echo "tunnel UP $(date -u +%FT%TZ)"
 
 # 1. headline bench
-BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r3_local.json" 2>/tmp/bench_r3.err \
-  && commit "On-chip headline bench (r3 local)" -- "$RES/BENCH_r3_local.json"
+BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_local.json" 2>/tmp/bench_r4.err \
+  && commit "On-chip headline bench (r4 local)" -- "$RES/BENCH_r4_local.json"
 
 # 2. lever sweep: the unmeasured big levers first
 sweep --remat --remat-policy dots --label "remat dots-policy"
@@ -67,28 +71,28 @@ if python - <<'EOF'
 import json, sys
 best_dots = 0.0
 try:
-    for line in open("bench_results/r3_sweep.jsonl"):
+    for line in open("bench_results/r4_sweep.jsonl"):
         r = json.loads(line)
-        if "dots-policy" in r.get("label", ""):
+        if "dots" in r.get("label", ""):
             best_dots = max(best_dots, r.get("mfu") or 0.0)
-    head = json.load(open("bench_results/BENCH_r3_local.json"))
+    head = json.load(open("bench_results/BENCH_r4_local.json"))
     sys.exit(0 if best_dots > head["detail"]["mfu"] else 1)
 except Exception:
     sys.exit(1)
 EOF
 then
   BENCH_REMAT_POLICY=dots BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
-    > "$RES/BENCH_r3_local_dots.json" 2>/dev/null \
-    && commit "On-chip headline bench with dots remat policy" -- "$RES/BENCH_r3_local_dots.json"
+    > "$RES/BENCH_r4_local_dots.json" 2>/dev/null \
+    && commit "On-chip headline bench with dots remat policy" -- "$RES/BENCH_r4_local_dots.json"
 fi
 
 # 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
 timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
-  > "$RES/r3_attn.jsonl" 2>/tmp/attn_r3.err \
-  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r3_attn.jsonl"
+  > "$RES/r4_attn.jsonl" 2>/tmp/attn_r4.err \
+  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r4_attn.jsonl"
 timeout 2400 python scripts/bench_attention.py --seqs 4096 16384 --impls xla pallas \
-  --kv-heads 4 >> "$RES/r3_attn.jsonl" 2>>/tmp/attn_r3.err \
-  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r3_attn.jsonl"
+  --kv-heads 4 >> "$RES/r4_attn.jsonl" 2>>/tmp/attn_r4.err \
+  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r4_attn.jsonl"
 
 # 4. quantized-base benches
 sweep --remat --quantize int8 --label "remat int8-base"
@@ -96,20 +100,32 @@ sweep --remat --quantize nf4 --label "remat nf4-base"
 RELORA_TPU_PALLAS_QUANT=1 sweep --remat --quantize int8 --label "remat int8-base pallas-dequant"
 
 # 5. extra configs
-BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r3_250m.json" 2>/dev/null \
-  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r3_250m.json"
-BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r3_magnitude.json" 2>/dev/null \
-  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r3_magnitude.json"
+BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_250m.json" 2>/dev/null \
+  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r4_250m.json"
+BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_magnitude.json" 2>/dev/null \
+  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r4_magnitude.json"
 
-# 6. loss parity (longest): 4000-step scaled config so both branches finish
-# inside a round (~1.6h on the v5e at 7k tok/s) — the CPU insurance run
-# (llama_9m, started separately) keeps its own WORK dir
+# 6. loss parity (longest): llama_35m, 4000 steps, 1000-step cycles — the
+# scale rung the round-3 verdict asked for (~1.6h/branch on the v5e).
+# loss_parity.sh keys run dirs by model/seed/variant, so the zero-reset and
+# magnitude variants share the warmup + full-rank branches.
 CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity \
   STEPS_WARMUP=500 STEPS_TOTAL=4000 bash scripts/loss_parity.sh \
   > /tmp/loss_parity.log 2>&1
 echo "loss_parity exit=$? $(date -u +%FT%TZ)"
-if [ -f /tmp/loss_parity/compare.json ]; then
-  cp /tmp/loss_parity/compare.json "$RES/r3_loss_parity_chip.json"
-  commit "On-chip loss-parity result" -- "$RES/r3_loss_parity_chip.json"
+if [ -f /tmp/loss_parity/compare_llama_35m.json ]; then
+  cp /tmp/loss_parity/compare_llama_35m.json "$RES/r4_loss_parity_chip.json"
+  commit "On-chip loss-parity result (llama_35m, 1000-step cycles)" -- "$RES/r4_loss_parity_chip.json"
+fi
+
+# 6b. magnitude-pruning reset at the same (reference-like) cycle length,
+# reusing the shared warmup/full-rank branches — only the ReLoRA branch runs
+CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity OPT_PRUNE=0.9 \
+  STEPS_WARMUP=500 STEPS_TOTAL=4000 bash scripts/loss_parity.sh \
+  > /tmp/loss_parity_mag.log 2>&1
+echo "loss_parity magnitude exit=$? $(date -u +%FT%TZ)"
+if [ -f /tmp/loss_parity/compare_llama_35m_mag0.9.json ]; then
+  cp /tmp/loss_parity/compare_llama_35m_mag0.9.json "$RES/r4_loss_parity_chip_mag.json"
+  commit "On-chip loss-parity: magnitude-pruning reset at 1000-step cycles" -- "$RES/r4_loss_parity_chip_mag.json"
 fi
 echo "watcher done $(date -u +%FT%TZ)"

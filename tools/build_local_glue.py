@@ -1,0 +1,190 @@
+"""Build GLUE-format classification tasks from local text (air-gapped hosts).
+
+The reference evaluates ReLoRA-pretrained checkpoints on GLUE via
+run_glue.py (reference run_glue.py:496-501); this sandbox has no hub
+access, so these tasks stand in for GLUE in the pretrain -> fine-tune ->
+metric pipeline: real discriminative tasks over the SAME local text the
+pretraining corpus was built from (tools/build_text_corpus.py roots), in
+run_glue.py's custom csv schema (``sentence[,sentence2],label``).
+
+Three tasks, mirroring GLUE's task shapes:
+
+- ``locdoc``   (SST-2 shape)  single segment, binary: code (.py) vs prose
+  (.md/.rst/.txt).  Metric: accuracy.
+- ``locpair``  (MRPC shape)   segment pair, binary: same document vs
+  different documents.  Metrics: accuracy + F1.
+- ``locorder`` (CoLA shape)   single segment, binary: natural word order
+  vs seeded word-shuffle.  Metric: accuracy (+F1; CoLA's Matthews is
+  keyed to the task name "cola" in eval/glue.py:task_metrics).
+
+Usage::
+
+    python tools/build_local_glue.py --out /tmp/local_glue \
+        --roots /opt/venv/lib/python3.12/site-packages /usr/share/doc \
+        --train 2000 --eval 400 --test 400
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.build_text_corpus import harvest  # same harvest as the pretrain corpus
+
+PROSE_EXT = (".md", ".rst", ".txt")
+SEG_MIN, SEG_MAX = 200, 400  # chars per segment
+
+
+def _segments(text: str, rng: random.Random, max_segments: int = 4):
+    """Cut a file into a few clean, non-overlapping segments."""
+    out = []
+    n = len(text)
+    if n < SEG_MIN:
+        return out
+    starts = rng.sample(range(0, max(n - SEG_MAX, 1)), k=min(max_segments, max(n // SEG_MAX, 1)))
+    for s in sorted(starts):
+        seg = " ".join(text[s : s + rng.randint(SEG_MIN, SEG_MAX)].split())
+        if len(seg) >= SEG_MIN // 2:
+            out.append(seg)
+    return out
+
+
+def build_pools(roots, max_mb: float, seed: int, need_per_class: int = 0):
+    """Harvest files and bucket segments by document and by code/prose.
+
+    Prose files (.md/.rst/.txt) are a small minority of the roots (mostly
+    python trees), so a flat byte cap starves the code-vs-prose task; keep
+    harvesting past the cap until BOTH classes can fill ``need_per_class``
+    segments (or the roots are exhausted)."""
+    rng = random.Random(seed)
+    docs = []  # (is_code, [segments])
+    n_code = n_prose = 0
+    harvested = 0
+    for path, text in harvest(roots, 1 << 40):
+        harvested += len(text)
+        segs = _segments(text, rng)
+        if len(segs) >= 2:
+            is_code = path.endswith(".py")
+            docs.append((is_code, segs))
+            if is_code:
+                n_code += len(segs)
+            else:
+                n_prose += len(segs)
+        if harvested >= max_mb * 1e6 and (
+            not need_per_class or min(n_code, n_prose) >= need_per_class
+        ):
+            break
+    rng.shuffle(docs)
+    return docs, rng
+
+
+def write_csv(path, rows, fields):
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        w.writerows(rows)
+
+
+def split_rows(rows, sizes):
+    out, i = [], 0
+    for n in sizes:
+        out.append(rows[i : i + n])
+        i += n
+    return out
+
+
+def task_locdoc(docs, rng, total):
+    """code vs prose, single segment, balanced."""
+    code = [s for is_code, segs in docs if is_code for s in segs]
+    prose = [s for is_code, segs in docs if not is_code for s in segs]
+    n = min(total // 2, len(code), len(prose))
+    rows = [{"sentence": s, "label": 1} for s in rng.sample(code, n)] + [
+        {"sentence": s, "label": 0} for s in rng.sample(prose, n)
+    ]
+    rng.shuffle(rows)
+    return rows, ("sentence", "label")
+
+
+def task_locpair(docs, rng, total):
+    """same-doc vs cross-doc segment pairs, balanced."""
+    rows = []
+    for i, (_, segs) in enumerate(docs):
+        if len(rows) >= total:
+            break
+        a, b = rng.sample(segs, 2)
+        rows.append({"sentence1": a, "sentence2": b, "label": 1})
+        other = docs[rng.randrange(len(docs))]
+        if other[1] is segs:
+            continue
+        rows.append({"sentence1": rng.choice(segs), "sentence2": rng.choice(other[1]), "label": 0})
+    rng.shuffle(rows)
+    return rows[:total], ("sentence1", "sentence2", "label")
+
+
+def task_locorder(docs, rng, total):
+    """natural vs word-shuffled segments, balanced (CoLA-like acceptability)."""
+    segs = [s for _, ss in docs for s in ss]
+    rng.shuffle(segs)
+    rows = []
+    for i, s in enumerate(segs[:total]):
+        if i % 2 == 0:
+            rows.append({"sentence": s, "label": 1})
+        else:
+            words = s.split()
+            rng.shuffle(words)
+            rows.append({"sentence": " ".join(words), "label": 0})
+    rng.shuffle(rows)
+    return rows, ("sentence", "label")
+
+
+TASKS = {"locdoc": task_locdoc, "locpair": task_locpair, "locorder": task_locorder}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument(
+        "--roots",
+        nargs="+",
+        default=["/opt/venv/lib/python3.12/site-packages", "/usr/share/doc", "/usr/lib/python3.12"],
+    )
+    p.add_argument("--max-mb", type=float, default=60.0)
+    p.add_argument("--train", type=int, default=2000)
+    p.add_argument("--eval", type=int, default=400)
+    p.add_argument("--test", type=int, default=400)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    total = args.train + args.eval + args.test
+    docs, rng = build_pools(args.roots, args.max_mb, args.seed, need_per_class=total // 2)
+    print(f"harvested {len(docs)} documents")
+    meta = {"roots": args.roots, "seed": args.seed, "n_docs": len(docs), "tasks": {}}
+    for name, fn in TASKS.items():
+        rows, fields = fn(docs, rng, total)
+        sizes = (args.train, args.eval, args.test)
+        if len(rows) < total:
+            # a class pool ran dry (prose is scarce in python trees): keep
+            # the requested train:eval:test proportions over what exists
+            sizes = tuple(int(len(rows) * s / total) for s in sizes)
+        tr, ev, te = split_rows(rows, sizes)
+        tdir = os.path.join(args.out, name)
+        os.makedirs(tdir, exist_ok=True)
+        write_csv(os.path.join(tdir, "train.csv"), tr, fields)
+        write_csv(os.path.join(tdir, "validation.csv"), ev, fields)
+        write_csv(os.path.join(tdir, "test.csv"), te, fields)
+        bal = sum(r["label"] for r in ev) / max(len(ev), 1)
+        meta["tasks"][name] = {"train": len(tr), "validation": len(ev), "test": len(te),
+                               "eval_label_balance": round(bal, 3), "fields": list(fields)}
+        print(f"{name}: train={len(tr)} validation={len(ev)} test={len(te)} eval_pos_rate={bal:.3f}")
+    with open(os.path.join(args.out, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

