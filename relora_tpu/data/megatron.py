@@ -46,15 +46,21 @@ class MegatronDataConfig:
     seq_length: int = 2048
     seed: int = 1234
     data_impl: str = "mmap"
+    # NeoX batch-arithmetic keys found in the YAML (not consumed for
+    # training — the training config owns batch arithmetic — but kept so
+    # the loader can solve/cross-check them once the mesh size is known)
+    neox_batch_keys: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_yaml(cls, path: str) -> "MegatronDataConfig":
         with open(path) as f:
             raw = yaml.safe_load(f)
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = {f.name for f in dataclasses.fields(cls) if f.name != "neox_batch_keys"}
         kwargs = {k: v for k, v in raw.items() if k in known and v not in ("", None)}
         cfg = cls(**kwargs)
-        _check_neox_batch_keys(raw, path)
+        # retained for the dp-aware cross-check once the mesh is known
+        # (cross_check_neox_batch in build_train_valid_test_iterators)
+        cfg.neox_batch_keys = _check_neox_batch_keys(raw, path)
         if cfg.data_impl not in ("mmap", "lazy", "cached", "infer"):
             raise NotImplementedError(
                 f"data_impl={cfg.data_impl!r}: supported are mmap/lazy/cached/infer"
@@ -64,54 +70,165 @@ class MegatronDataConfig:
         return cfg
 
 
-def _check_neox_batch_keys(raw: dict, path: str) -> None:
-    """Cross-check NeoX batch-arithmetic keys we deliberately don't consume.
+def solve_batch_parameters(
+    dp_world_size: int,
+    train_batch: Optional[int] = None,
+    micro_batch: Optional[int] = None,
+    grad_acc: Optional[int] = None,
+) -> tuple:
+    """Solve the NeoX batch triple for whatever values are missing.
+
+    Reference-equivalent case analysis (NeoXArgs.calculate_batch_parameters,
+    megatron_dataset/arguments.py:753-791), floor-division quirks included:
+    given any sufficient subset of {train_batch_size,
+    micro_batch_per_rank, grad_accum_steps} and the data-parallel world
+    size, returns the completed ``(train_batch, micro_batch, grad_acc)``.
+    Raises ValueError when neither train_batch nor micro_batch is given
+    (the reference asserts there).
+    """
+    if train_batch is not None and micro_batch is not None and grad_acc is not None:
+        pass  # fully specified
+    elif train_batch is not None and micro_batch is not None:
+        grad_acc = (train_batch // micro_batch) // dp_world_size
+    elif train_batch is not None and grad_acc is not None:
+        micro_batch = (train_batch // dp_world_size) // grad_acc
+    elif micro_batch is not None and grad_acc is not None:
+        train_batch = micro_batch * grad_acc * dp_world_size
+    elif train_batch is not None:
+        grad_acc = 1
+        micro_batch = train_batch // dp_world_size
+    elif micro_batch is not None:
+        train_batch = micro_batch * dp_world_size
+        grad_acc = 1
+    else:
+        raise ValueError(
+            "batch arithmetic needs train_batch_size or "
+            "train_micro_batch_size_per_gpu (arguments.py:788-791)"
+        )
+    return int(train_batch), int(micro_batch), int(grad_acc)
+
+
+def check_batch_parameters(
+    dp_world_size: int, train_batch: int, micro_batch: int, grad_acc: int
+) -> None:
+    """Validate a completed batch triple (reference:
+    NeoXArgs.check_batch_parameters, arguments.py:793-812): all three
+    positive and train_batch == micro_batch * grad_acc * dp_world_size.
+    Raises ValueError on violation."""
+    for name, v in (
+        ("train_batch_size", train_batch),
+        ("micro_batch_per_rank", micro_batch),
+        ("gradient_accumulation_steps", grad_acc),
+    ):
+        if v <= 0:
+            raise ValueError(f"{name}={v} must be > 0")
+    if train_batch != micro_batch * grad_acc * dp_world_size:
+        raise ValueError(
+            f"inconsistent batch arithmetic: train_batch_size={train_batch} != "
+            f"micro={micro_batch} * grad_accum={grad_acc} * dp={dp_world_size}"
+        )
+
+
+def cross_check_neox_batch(
+    mcfg: "MegatronDataConfig",
+    path: str,
+    dp_world_size: int,
+    micro_batch: int,
+    grad_accum: int,
+    total_batch_size: int,
+) -> None:
+    """Solve the YAML's NeoX batch keys against the ACTUAL mesh size and
+    warn when the result disagrees with the training config.
+
+    The training config owns batch arithmetic in this framework (the
+    reference instead derives it from the NeoX YAML + world size,
+    arguments.py:753-812); a NeoX YAML carrying batch keys that solve to a
+    different recipe than the one actually running deserves a loud warning,
+    not silence — but not a hard failure, since reference data YAMLs must
+    keep loading unchanged.
+    """
+    keys = mcfg.neox_batch_keys or {}
+    if not keys:
+        return
+    try:
+        solved = solve_batch_parameters(
+            dp_world_size,
+            train_batch=keys.get("train_batch_size"),
+            micro_batch=keys.get("train_micro_batch_size_per_gpu"),
+            grad_acc=keys.get("gradient_accumulation_steps"),
+        )
+        check_batch_parameters(dp_world_size, *solved)
+    # ZeroDivisionError: a zero-valued divisor key (e.g. micro_batch: 0 with
+    # no grad_acc) reaches the solver's floor divisions — warn, don't crash
+    except (ValueError, TypeError, ZeroDivisionError) as e:
+        logger.warning("%s: NeoX batch keys do not solve at dp=%s: %s", path, dp_world_size, e)
+        return
+    actual = (total_batch_size, micro_batch, grad_accum)
+    if solved != actual:
+        logger.warning(
+            "%s: NeoX batch keys solve to (train=%s, micro=%s, grad_acc=%s) at "
+            "dp=%s, but the training config runs (total=%s, micro=%s, "
+            "grad_acc=%s) — the training config wins",
+            path, *solved, dp_world_size, *actual,
+        )
+    else:
+        logger.info(
+            "%s: NeoX batch keys consistent with the training config at dp=%s",
+            path, dp_world_size,
+        )
+
+
+def _check_neox_batch_keys(raw: dict, path: str) -> dict:
+    """Collect the NeoX batch-arithmetic keys we deliberately don't consume
+    for training, warning that they are data-YAML passengers here.
 
     The reference solves/validates train_batch_size = micro_batch_per_gpu *
     gradient_accumulation_steps * world_size when loading a NeoX YAML
-    (megatron_dataset/arguments.py:754-812). We collapse NeoXArgs to the data
-    surface the training path reads, so those keys are ignored here — but a
-    YAML whose batch fields are internally inconsistent should warn instead
-    of being silently accepted.
+    (megatron_dataset/arguments.py:753-812).  The full solve/validate runs
+    later, against the real mesh size (cross_check_neox_batch); this
+    load-time pass only flags triples that are impossible at ANY world size.
+    Returns the present keys (ints where parseable).
     """
-    tbs = raw.get("train_batch_size")
-    micro = raw.get("train_micro_batch_size_per_gpu")
-    ga = raw.get("gradient_accumulation_steps")
-    present = {
-        k: v
-        for k, v in (
-            ("train_batch_size", tbs),
-            ("train_micro_batch_size_per_gpu", micro),
-            ("gradient_accumulation_steps", ga),
-        )
-        if v is not None
-    }
+    present = {}
+    for k in (
+        "train_batch_size",
+        "train_micro_batch_size_per_gpu",
+        "gradient_accumulation_steps",
+    ):
+        v = raw.get(k)
+        if v is None:
+            continue
+        try:
+            present[k] = int(v)
+        except (TypeError, ValueError):
+            present[k] = v
     if present:
         logger.warning(
             "%s: NeoX batch keys %s are not consumed by relora_tpu "
-            "(batch arithmetic is set by the training config, not the data YAML)",
+            "(batch arithmetic is set by the training config, not the data "
+            "YAML); they will be cross-checked against the mesh at startup",
             path,
             sorted(present),
         )
-    if tbs is not None and micro is not None and ga is not None:
-        try:
-            tbs_i, micro_i, ga_i = int(tbs), int(micro), int(ga)
-        except (TypeError, ValueError):
-            return
-        # world_size isn't knowable from the YAML; consistency requires
+    tbs = present.get("train_batch_size")
+    micro = present.get("train_micro_batch_size_per_gpu")
+    ga = present.get("gradient_accumulation_steps")
+    if isinstance(tbs, int) and isinstance(micro, int) and isinstance(ga, int):
+        # world_size isn't knowable yet; consistency at ANY size requires
         # train_batch_size to be a positive multiple of micro * grad_accum
-        per_rank = micro_i * ga_i
-        if per_rank <= 0 or tbs_i <= 0 or tbs_i % per_rank != 0:
+        per_rank = micro * ga
+        if per_rank <= 0 or tbs <= 0 or tbs % per_rank != 0:
             logger.warning(
                 "%s: inconsistent NeoX batch arithmetic: train_batch_size=%s "
                 "is not a positive multiple of train_micro_batch_size_per_gpu=%s "
                 "* gradient_accumulation_steps=%s (reference validates this in "
-                "arguments.py:754-812)",
+                "arguments.py:753-812)",
                 path,
                 tbs,
                 micro,
                 ga,
             )
+    return present
 
 
 def parse_split_string(split: str, n: int) -> List[range]:
@@ -299,6 +416,16 @@ def build_train_valid_test_iterators(cfg, trainer):
         logger.warning(
             f"megatron seq_length={mcfg.seq_length} < max_length={cfg.max_length}"
         )
+    # the mesh is known here: solve the YAML's NeoX batch keys at the real
+    # data-parallel size and compare with what's actually running
+    cross_check_neox_batch(
+        mcfg,
+        cfg.megatron_dataset_config,
+        dp_world_size=trainer.n_batch_shards,
+        micro_batch=cfg.batch_size,
+        grad_accum=trainer.grad_accum,
+        total_batch_size=cfg.total_batch_size,
+    )
 
     n_train = cfg.num_training_steps * cfg.total_batch_size
     # eval sees each token at most once (one pass of the split), capped at

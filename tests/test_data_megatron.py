@@ -286,6 +286,108 @@ def test_yaml_inconsistent_neox_batch_keys_warn(tmp_path):
     p2.write_text(yaml.safe_dump(raw))
     out = load_capturing(p2)
     assert "not consumed" in out and "inconsistent NeoX batch arithmetic" not in out
+    # the present keys are retained (as ints) for the dp-aware cross-check
+    mcfg = MegatronDataConfig.from_yaml(str(p2))
+    assert mcfg.neox_batch_keys == {
+        "train_batch_size": 48,
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 3,
+    }
+
+
+def test_solve_batch_parameters_reference_cases():
+    """Solver completes any sufficient subset of the NeoX batch triple with
+    the reference's exact case analysis — floor-division quirks included
+    (NeoXArgs.calculate_batch_parameters, arguments.py:753-791)."""
+    from relora_tpu.data.megatron import check_batch_parameters, solve_batch_parameters
+
+    # fully specified: returned untouched (even if inconsistent — check is
+    # a separate step, as in the reference)
+    assert solve_batch_parameters(2, 64, 8, 4) == (64, 8, 4)
+    # train+micro -> grad_acc = (train // micro) // dp
+    assert solve_batch_parameters(2, 64, 8, None) == (64, 8, 4)
+    # train+grad_acc -> micro = (train // dp) // grad_acc
+    assert solve_batch_parameters(2, 64, None, 4) == (64, 8, 4)
+    # micro+grad_acc -> train = micro * grad_acc * dp
+    assert solve_batch_parameters(2, None, 8, 4) == (64, 8, 4)
+    # train only -> grad_acc 1, micro = train // dp
+    assert solve_batch_parameters(4, 64, None, None) == (64, 16, 1)
+    # micro only -> train = micro * dp, grad_acc 1
+    assert solve_batch_parameters(4, None, 16, None) == (64, 16, 1)
+    # reference floor-division quirk preserved: non-divisible inputs floor
+    assert solve_batch_parameters(2, 100, 8, None) == (100, 8, 6)
+    # insufficient: neither train nor micro
+    with pytest.raises(ValueError):
+        solve_batch_parameters(2, None, None, 4)
+
+    check_batch_parameters(2, 64, 8, 4)  # consistent: no raise
+    with pytest.raises(ValueError):
+        check_batch_parameters(2, 100, 8, 6)  # 100 != 8*6*2
+    with pytest.raises(ValueError):
+        check_batch_parameters(2, 64, 0, 4)
+
+
+def test_cross_check_neox_batch_against_mesh(tmp_path):
+    """At startup the YAML's batch keys are solved at the REAL dp size and
+    compared with the training config: agreement logs info, disagreement
+    warns, unsolvable warns — never raises (reference YAMLs keep loading)."""
+    import io
+    import logging as _logging
+
+    import yaml
+
+    from relora_tpu.data.megatron import cross_check_neox_batch
+
+    def capture(fn):
+        buf = io.StringIO()
+        h = _logging.StreamHandler(buf)
+        lg = _logging.getLogger("relora_tpu.data.megatron")
+        old_level = lg.level
+        lg.setLevel(_logging.INFO)
+        lg.addHandler(h)
+        try:
+            fn()
+        finally:
+            lg.removeHandler(h)
+            lg.setLevel(old_level)
+        return buf.getvalue()
+
+    prefix, _ = write_corpus(tmp_path)
+    raw = {
+        "train_data_paths": [prefix],
+        "seq_length": 16,
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 8,
+    }
+    p = tmp_path / "neox.yaml"
+    p.write_text(yaml.safe_dump(raw))
+    mcfg = MegatronDataConfig.from_yaml(str(p))
+
+    # solved at dp=2: (64, 8, 4) == training config -> consistent
+    out = capture(lambda: cross_check_neox_batch(
+        mcfg, str(p), 2, micro_batch=8, grad_accum=4, total_batch_size=64))
+    assert "consistent with the training config" in out
+
+    # training config disagrees -> warning naming both triples
+    out = capture(lambda: cross_check_neox_batch(
+        mcfg, str(p), 2, micro_batch=4, grad_accum=4, total_batch_size=32))
+    assert "the training config wins" in out
+
+    # keys that cannot solve (grad_acc alone) warn instead of raising
+    mcfg.neox_batch_keys = {"gradient_accumulation_steps": 4}
+    out = capture(lambda: cross_check_neox_batch(
+        mcfg, str(p), 2, micro_batch=4, grad_accum=4, total_batch_size=32))
+    assert "do not solve" in out
+
+    # a zero divisor key hits the solver's floor division: warn, never crash
+    mcfg.neox_batch_keys = {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 0}
+    out = capture(lambda: cross_check_neox_batch(
+        mcfg, str(p), 2, micro_batch=4, grad_accum=4, total_batch_size=32))
+    assert "do not solve" in out
+
+    # no keys: silent no-op
+    mcfg.neox_batch_keys = {}
+    assert capture(lambda: cross_check_neox_batch(mcfg, str(p), 2, 4, 4, 32)) == ""
 
 
 def test_bert_mapping_builders():
