@@ -57,7 +57,7 @@ def bench_one(
         "seq": S,
         "kv_heads": n_kv,
         "ms": round(dt * 1e3, 2),
-        "tflops": round(flops / dt / 1e12, 1),
+        "tflops": round(flops / dt / 1e12, 3),
     }
 
 

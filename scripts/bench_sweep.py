@@ -99,6 +99,9 @@ def main() -> None:
             "step_time_s": res["step_time_s"],
             "loss": round(res["loss"], 6),
             "hbm_peak_gb": res.get("hbm_peak_gb"),
+            # benchlib floors warmup to 1 step; surface the effective count
+            # so a --warmup 0 sweep can't misattribute its measurement
+            "warmup_steps_effective": res.get("warmup_steps_effective"),
         }
     )
     print(line)
