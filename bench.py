@@ -70,12 +70,20 @@ _CFG = BENCH_CONFIGS[_CFG_NAME]
 def main() -> None:
     from relora_tpu.utils.benchlib import run_throughput_bench
 
-    # BENCH_REMAT_POLICY=dots selects the dots-saveable remat policy (keeps
-    # matmul outputs as residuals, recomputing only cheap elementwise ops);
-    # default "full" recomputes the whole layer.  Headline stays overridable
-    # so the measured-best policy can drive the driver-run number.
+    # BENCH_REMAT_POLICY=dots|dots_all selects the remat policy; default
+    # "full" recomputes the whole layer.  BENCH_MICRO_BATCH overrides the
+    # config's micro-batch (dots_all keeps S^2 residuals and may only fit
+    # at a smaller size).  Headline stays overridable so the measured-best
+    # lever combo can drive the driver-run number.
     policy = os.environ.get("BENCH_REMAT_POLICY", "full")
-    res = run_throughput_bench(remat=True, remat_policy=policy, rank=128, **_CFG)
+    cfg = dict(_CFG)
+    mb_override = os.environ.get("BENCH_MICRO_BATCH")
+    if mb_override:
+        cfg["micro_batch"] = int(mb_override)
+    loss_impl = os.environ.get("BENCH_LOSS_IMPL", "dense")
+    res = run_throughput_bench(
+        remat=True, remat_policy=policy, rank=128, loss_impl=loss_impl, **cfg
+    )
     print(
         json.dumps(
             {

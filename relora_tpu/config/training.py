@@ -115,7 +115,7 @@ class TrainingConfig:
     sp_size: int = 1  # sequence (context parallel) axis
     sp_impl: str = "ring"  # ring (streamed K/V) | ulysses (all-to-all heads)
     remat: bool = False  # gradient checkpointing on decoder layers
-    remat_policy: str = "full"  # 'full' (save nothing) | 'dots' (save matmuls)
+    remat_policy: str = "full"  # 'full' | 'dots' | 'dots_all' (params_util.remat_policy)
     bf16_logits: bool = False  # halve the logits HBM footprint; CE still f32
     loss_impl: str = "dense"  # dense | chunked (streamed vocab CE, no full logits)
     vocab_chunk: int = 8192  # chunk size for loss_impl=chunked
@@ -240,9 +240,10 @@ class TrainingConfig:
 
         if self.quantize not in (None, "int8", "nf4"):
             raise ValueError(f"quantize must be None, 'int8' or 'nf4', got {self.quantize!r}")
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "dots_all"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
+                "remat_policy must be 'full', 'dots' or 'dots_all', "
+                f"got {self.remat_policy!r}"
             )
 
         self._finalized = True

@@ -25,14 +25,24 @@ def remat_policy(name: str):
       minimum memory, ~1/3 extra FLOPs.
     - ``"dots"``: save matmul outputs without batch dims
       (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``) —
-      recomputes only the cheap elementwise/softmax work; more memory,
-      less recompute.  The right trade when HBM headroom exists.
+      recomputes only batched dots (attention QKᵀ/PV) plus the cheap
+      elementwise/softmax work; more memory, less recompute.  The right
+      trade when HBM headroom exists.
+    - ``"dots_all"``: save EVERY dot output including the attention
+      logits/probs (``jax.checkpoint_policies.dots_saveable``) — minimum
+      recompute, maximum residual memory (the S²-per-head probs are kept,
+      in compute dtype); viable only at reduced micro-batch or short
+      sequences.
     """
     if name == "full":
         return None
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-    raise ValueError(f"Unknown remat policy {name!r} (use 'full' or 'dots')")
+    if name == "dots_all":
+        return jax.checkpoint_policies.dots_saveable
+    raise ValueError(
+        f"Unknown remat policy {name!r} (use 'full', 'dots', or 'dots_all')"
+    )
 
 
 def init_params(model: nn.Module, rng: jax.Array, *sample_args, **sample_kwargs) -> PyTree:

@@ -40,7 +40,9 @@ def main() -> None:
     p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--remat", action="store_true")
-    p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
+    p.add_argument(
+        "--remat-policy", default="full", choices=["full", "dots", "dots_all"]
+    )
     p.add_argument("--loss-impl", default="dense", choices=["dense", "chunked"])
     p.add_argument("--vocab-chunk", type=int, default=8192)
     p.add_argument("--logits-dtype", default="f32", choices=["f32", "bf16"])

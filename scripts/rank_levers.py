@@ -44,6 +44,8 @@ CONFIGS = [
     ("remat dots-policy", dict(remat=True, remat_policy="dots")),
     ("remat dots chunked mb16", dict(remat=True, remat_policy="dots", loss_impl="chunked", micro_batch=16)),
     ("remat dots dropout0", dict(remat=True, remat_policy="dots", dropout=0.0)),
+    ("remat dots_all chunked mb4", dict(remat=True, remat_policy="dots_all", loss_impl="chunked", micro_batch=4)),
+    ("remat dots_all chunked mb8", dict(remat=True, remat_policy="dots_all", loss_impl="chunked", micro_batch=8)),
     ("remat full dropout0", dict(remat=True, dropout=0.0)),
     ("remat full chunked mb16", dict(remat=True, loss_impl="chunked", micro_batch=16)),
     ("remat full bf16-logits", dict(remat=True, logits_dtype="bf16")),

@@ -58,32 +58,54 @@ BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_local.jso
   && commit "On-chip headline bench (r4 local)" -- "$RES/BENCH_r4_local.json"
 
 # 2. lever sweep: the unmeasured big levers first
-sweep --remat --remat-policy dots --label "remat dots-policy"
+# predicted-MFU order (bench_results/r4_lever_rank.json): a mid-stage
+# outage should leave the highest-value measurements behind.
+# dots_all keeps the S^2 attention logits as residuals: minimum recompute,
+# may OOM at mb8 (the sweep records the error line and moves on) — mb4
+# (identical FLOPs/token) runs ONLY as the OOM fallback
+sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 8 --label "remat dots_all chunked mb8"
+if tail -n 2 "$RES/r4_sweep.jsonl" 2>/dev/null | grep -q '"error".*dots_all.*micro-batch 8\|failed.*dots_all'; then
+  sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 4 --label "remat dots_all chunked mb4"
+fi
 sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 16 --label "remat dots chunked mb16"
+sweep --remat --remat-policy dots --label "remat dots-policy"
 sweep --remat --remat-policy dots --dropout 0 --label "remat dots dropout0"
 sweep --remat --dropout 0 --label "remat full dropout0"
 sweep --remat --prng rbg --label "remat full rbg-prng"
 sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
 
-# 2b. if the dots policy beat the stage-1 headline, land a headline number
-# with the winning policy too (driver-format JSON, committed)
-if python - <<'EOF'
-import json, sys
-best_dots = 0.0
+# 2b. if a dots-family policy beat the stage-1 headline, land a headline
+# number with the WINNING policy at the micro-batch it actually won at
+# (dots_all may only fit at mb4; bench.py honors BENCH_MICRO_BATCH)
+BEST=$(python - <<'EOF'
+import json, re
+best_mfu, best = 0.0, ""
 try:
     for line in open("bench_results/r4_sweep.jsonl"):
         r = json.loads(line)
-        if "dots" in r.get("label", ""):
-            best_dots = max(best_dots, r.get("mfu") or 0.0)
+        label = r.get("label", "")
+        mfu = r.get("mfu") or 0.0
+        if "dots" in label and mfu > best_mfu:
+            m = re.search(r"mb(\d+)", label)
+            best_mfu = mfu
+            best = ":".join((
+                "dots_all" if "dots_all" in label else "dots",
+                m.group(1) if m else "8",
+                "chunked" if "chunked" in label else "dense",
+            ))
     head = json.load(open("bench_results/BENCH_r4_local.json"))
-    sys.exit(0 if best_dots > head["detail"]["mfu"] else 1)
+    print(best if best_mfu > head["detail"]["mfu"] else "")
 except Exception:
-    sys.exit(1)
+    print("")
 EOF
-then
-  BENCH_REMAT_POLICY=dots BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
-    > "$RES/BENCH_r4_local_dots.json" 2>/dev/null \
-    && commit "On-chip headline bench with dots remat policy" -- "$RES/BENCH_r4_local_dots.json"
+)
+if [ -n "$BEST" ]; then
+  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS <<< "$BEST"
+  BENCH_REMAT_POLICY="$BEST_POLICY" BENCH_MICRO_BATCH="$BEST_MB" \
+    BENCH_LOSS_IMPL="$BEST_LOSS" \
+    BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
+    > "$RES/BENCH_r4_local_${BEST_POLICY}.json" 2>/dev/null \
+    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss)" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json"
 fi
 
 # 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
