@@ -81,8 +81,10 @@ def main() -> None:
     if mb_override:
         cfg["micro_batch"] = int(mb_override)
     loss_impl = os.environ.get("BENCH_LOSS_IMPL", "dense")
+    dropout = float(os.environ.get("BENCH_DROPOUT", "0.1"))
     res = run_throughput_bench(
-        remat=True, remat_policy=policy, rank=128, loss_impl=loss_impl, **cfg
+        remat=True, remat_policy=policy, rank=128, loss_impl=loss_impl,
+        dropout=dropout, **cfg
     )
     print(
         json.dumps(

@@ -58,6 +58,14 @@ class LoRALinear(nn.Module):
         # quantization follows the LoRA spec (parity: quantize lives in
         # ReLoRaConfig, relora.py:18-28) unless set explicitly
         quantize = self.quantize or (self.lora.quantize if self.lora else None)
+        if quantize == "nf4" and in_features % 2:
+            # nf4 packs two codes per byte along in_features; an odd width
+            # (e.g. llama_1b's 5461-wide down_proj) can't pack, so this
+            # projection falls back to int8 — the rest of the model stays
+            # nf4, and the per-module merge dispatches on leaf names so a
+            # mixed base merges correctly (bnb instead pads the flattened
+            # tensor, reference relora.py:222-238)
+            quantize = "int8"
         if quantize == "int8":
             from relora_tpu.ops.quant import dequantize_int8
 

@@ -58,21 +58,21 @@ BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_local.jso
   && commit "On-chip headline bench (r4 local)" -- "$RES/BENCH_r4_local.json"
 
 # 2. lever sweep: the unmeasured big levers first
-# predicted-MFU order (bench_results/r4_lever_rank.json): a mid-stage
-# outage should leave the highest-value measurements behind.
-# dots_all keeps the S^2 attention logits as residuals: minimum recompute,
-# may OOM at mb8 (the sweep records the error line and moves on) — mb4
-# (identical FLOPs/token) runs ONLY as the OOM fallback
-sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 8 --label "remat dots_all chunked mb8"
-if tail -n 2 "$RES/r4_sweep.jsonl" 2>/dev/null | grep -q '"error".*dots_all.*micro-batch 8\|failed.*dots_all'; then
-  sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 4 --label "remat dots_all chunked mb4"
-fi
-sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 16 --label "remat dots chunked mb16"
-sweep --remat --remat-policy dots --label "remat dots-policy"
-sweep --remat --remat-policy dots --dropout 0 --label "remat dots dropout0"
+# Queue = the configs tools/plan_memory says FIT a 16 GB v5e at 1B/seq1024
+# (the naive dots-family mb8/mb16 plans need 19-32 GB — r1's "compile
+# rejected" dots attempts were never going to run), ordered by expected
+# value: the dots policy cuts executed matmul FLOPs 24% (r4_lever_rank),
+# so its small-mb configs lead; large-mb full-remat trades no FLOPs but
+# better MXU utilization; dots_all mb2 misses the 90% HBM budget by 0.3 GB
+# and gets exactly one attempt (a failure line is recorded and we move on).
+sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "remat dots chunked mb4"
+sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 2 --label "remat dots chunked mb2"
+sweep --remat --loss-impl chunked --micro-batch 32 --label "remat full chunked mb32"
+sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 2 --label "remat dots_all chunked mb2"
 sweep --remat --dropout 0 --label "remat full dropout0"
 sweep --remat --prng rbg --label "remat full rbg-prng"
 sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
+sweep --remat --loss-impl chunked --micro-batch 24 --label "remat full chunked mb24"
 
 # 2b. if a dots-family policy beat the stage-1 headline, land a headline
 # number with the WINNING policy at the micro-batch it actually won at
@@ -92,6 +92,7 @@ try:
                 "dots_all" if "dots_all" in label else "dots",
                 m.group(1) if m else "8",
                 "chunked" if "chunked" in label else "dense",
+                "0" if "dropout0" in label else "0.1",
             ))
     head = json.load(open("bench_results/BENCH_r4_local.json"))
     print(best if best_mfu > head["detail"]["mfu"] else "")
@@ -100,12 +101,12 @@ except Exception:
 EOF
 )
 if [ -n "$BEST" ]; then
-  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS <<< "$BEST"
+  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS BEST_DROPOUT <<< "$BEST"
   BENCH_REMAT_POLICY="$BEST_POLICY" BENCH_MICRO_BATCH="$BEST_MB" \
-    BENCH_LOSS_IMPL="$BEST_LOSS" \
+    BENCH_LOSS_IMPL="$BEST_LOSS" BENCH_DROPOUT="$BEST_DROPOUT" \
     BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
     > "$RES/BENCH_r4_local_${BEST_POLICY}.json" 2>/dev/null \
-    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss)" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json"
+    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT)" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json"
 fi
 
 # 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)

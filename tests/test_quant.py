@@ -155,6 +155,33 @@ NF4_TINY = ModelConfig(
 )
 
 
+def test_nf4_odd_width_falls_back_to_int8():
+    """nf4 can't nibble-pack an odd in_features (llama_1b's down_proj is
+    5461 wide — found by the at-shape dryrun); that projection falls back
+    to int8 while the rest of the model stays nf4, and the per-module
+    merge handles the mixed base."""
+    import dataclasses
+
+    odd_cfg = dataclasses.replace(NF4_TINY, intermediate_size=9)
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0, quantize="nf4")
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    model = LlamaForCausalLM(odd_cfg, lora=spec, dtype=jnp.float32)
+    params = init_params(model, jax.random.PRNGKey(0), ids)
+
+    mlp = params["layers"]["mlp"]
+    # down_proj (in_features=9, odd) fell back to int8 leaves...
+    assert "kernel_q" in mlp["down_proj"] and "kernel_codes" not in mlp["down_proj"]
+    # ...while even-width projections kept nf4
+    assert "kernel_codes" in params["layers"]["self_attn"]["q_proj"]
+
+    out = model.apply({"params": params}, ids)
+    assert np.isfinite(np.asarray(out)).all()
+    # the defining op works over the mixed-quantization tree
+    merged = merge_and_reinit(params, jax.random.PRNGKey(3), spec)
+    assert "kernel_q" in merged["layers"]["mlp"]["down_proj"]
+    assert "kernel_codes" in merged["layers"]["self_attn"]["q_proj"]
+
+
 @pytest.mark.parametrize("double_quant", [True, False])
 def test_nf4_roundtrip_accuracy(double_quant):
     w = jax.random.normal(jax.random.PRNGKey(0), (256, 32)) * 0.1

@@ -33,6 +33,7 @@ import json
 import math
 import os
 import sys
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -57,6 +58,7 @@ def run_at_shape(
     magnitude_reset: bool = False,
     attn: str = "auto",
     tolerance: float = 0.06,
+    quantize: Optional[str] = None,
 ) -> dict:
     """Jit + run the full sharded train step at real dims and assert the
     measured per-device bytes against the analytic plan.  Requires jax to be
@@ -110,7 +112,7 @@ def run_at_shape(
     set_current_mesh(mesh)
 
     cfg = dataclasses.replace(MODEL_ZOO[model], num_hidden_layers=layers)
-    spec = LoraSpec(r=rank, alpha=32, dropout=0.0)
+    spec = LoraSpec(r=rank, alpha=32, dropout=0.0, quantize=quantize)
     mdl = LlamaForCausalLM(
         cfg, lora=spec, dtype=jnp.bfloat16, scan_layers=True,
         attention_impl=attn,
@@ -197,6 +199,7 @@ def run_at_shape(
             seq=seq,
             chip=chip,
             layers=layers,
+            quantize=quantize,
         )["per_device_bytes"].items()
     }
 
@@ -212,13 +215,14 @@ def run_at_shape(
         "layers": layers,
         "seq": seq,
         "attn": attn,
+        "quantize": quantize,
         "loss": round(loss, 4),
         "measured_dev0_gb": {k: round(v, 4) for k, v in measured.items()},
         "after_step_dev0_gb": {k: round(v, 4) for k, v in after_step.items()},
         "planned_dev0_gb": {k: predicted[k] for k in measured},
-        "full_depth_plan_gb": plan(model, rank=rank, mesh=mesh_str, chip=chip)[
-            "per_device_gb"
-        ]["total"],
+        "full_depth_plan_gb": plan(
+            model, rank=rank, mesh=mesh_str, chip=chip, quantize=quantize
+        )["per_device_gb"]["total"],
         "ok": not failures,
         "failures": failures,
     }
@@ -234,6 +238,9 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=256)
     p.add_argument("--chip", default="v4")
     p.add_argument("--magnitude-reset", action="store_true")
+    p.add_argument("--quantize", default=None, choices=["int8", "nf4"],
+                   help="quantized frozen base: certifies the memory-win "
+                        "claim at real dims (measured vs planned bytes)")
     p.add_argument(
         "--attn",
         default="auto",
@@ -274,6 +281,7 @@ def main() -> None:
         magnitude_reset=args.magnitude_reset,
         attn=args.attn,
         tolerance=args.tolerance,
+        quantize=args.quantize,
     )
     print(json.dumps(out, indent=2))
     if out["failures"]:

@@ -96,7 +96,13 @@ def plan(
     cfg = MODEL_ZOO[model] if model in MODEL_ZOO else load_model_config(model)
     if layers:
         cfg = dataclasses.replace(cfg, num_hidden_layers=layers)
-    spec = LoraSpec(r=rank, alpha=32, dropout=0.0) if rank else None
+    # build WITH quantize so the abstract tree carries the real quantized
+    # leaves (codes / scales, incl. the odd-width int8 fallback): frozen
+    # bytes are then computed exactly from leaf shapes+dtypes instead of an
+    # approximate per-element factor model
+    spec = (
+        LoraSpec(r=rank, alpha=32, dropout=0.0, quantize=quantize) if rank else None
+    )
     jdtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     mdl = LlamaForCausalLM(cfg, lora=spec, dtype=jdtype, scan_layers=True)
     sample = jnp.zeros((1, 8), jnp.int32)
@@ -133,18 +139,18 @@ def plan(
         div = shard_div(flat_specs.get(key))
         n = leaf.size / div
         trainable = not flat_frozen.get(key, False) if rank else True
-        # param storage dtype: params are stored f32 (master) except the
-        # quantized frozen base
+        # param storage dtype: params are stored f32 (master); the frozen
+        # base's leaves are whatever the model actually declares (f32
+        # kernels, or int8/nf4 codes + scales when quantize is set — the
+        # abstract tree was built with the real quantize mode, so
+        # size × itemsize is exact, replication of small scale leaves
+        # included via their own sharding specs)
         if trainable:
             trainable_bytes += n * 4
             opt_bytes += n * 4 * 2  # adam mu+nu f32
             grad_bytes += n * 4
-        elif quantize == "int8":
-            frozen_bytes += n * (1 + 4 / 256)  # codes + per-channel scales
-        elif quantize == "nf4":
-            frozen_bytes += n * (0.5 + 1 / 64 + 4 / 4096)  # nibbles + dq scales
         else:
-            frozen_bytes += n * 4
+            frozen_bytes += n * leaf.dtype.itemsize
     # --- activations ---------------------------------------------------
     B, S, H, L = micro_batch, seq, cfg.hidden_size, cfg.num_hidden_layers
     batch_div = mesh_factors.get("data", 1) * mesh_factors.get("fsdp", 1)
@@ -158,6 +164,14 @@ def plan(
         # boundaries + saved matmul outputs (qkv, attn out, 3 mlp)
         inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
         per_layer = tok * (H * 5 + inter * 3) * bytes_el
+        act = L * per_layer
+    elif remat == "dots_all":
+        # dots_saveable additionally keeps the S^2-per-head attention
+        # logits as residuals, in COMPUTE dtype (params_util.remat_policy)
+        inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
+        per_layer = tok * (H * 5 + inter * 3) * bytes_el + (
+            (B / batch_div) * heads * (S / seq_div) * S * bytes_el
+        )
         act = L * per_layer
     else:  # none: dense residuals incl. f32 S^2 attention probs (measured)
         inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
@@ -211,7 +225,7 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--quantize", default=None, choices=[None, "int8", "nf4"])
-    p.add_argument("--remat", default="full", choices=["full", "dots", "none"])
+    p.add_argument("--remat", default="full", choices=["full", "dots", "dots_all", "none"])
     p.add_argument("--loss", default="dense", choices=["dense", "chunked"])
     p.add_argument("--chip", default="v5e", choices=sorted(CHIP_HBM))
     p.add_argument("--layers", type=int, default=0, help="override layer count")
