@@ -14,6 +14,13 @@ throughput numbers (BASELINE.md), so the committed target is the north-star
 threshold, which caps microbatch at 8 here; MFU counts only the 6N model
 FLOPs, so remat recompute deflates it.)
 
+Outage behavior: a fast pre-probe initializes the device in a subprocess;
+if it times out (tunnel down) or reports a cpu-only backend, the script
+emits the last committed on-chip measurement from
+``bench_results/last_onchip.json`` with ``detail.stale: true`` and the
+reason — old-but-real signal instead of a zero.  ``BENCH_FORCE=1`` skips
+the probe.
+
 Other BASELINE.md benchmark configs are selectable by env var, e.g.
 ``BENCH_CONFIG=llama_250m python bench.py``.  The measurement loop itself
 lives in relora_tpu.utils.benchlib (shared with scripts/bench_sweep.py).
@@ -23,30 +30,87 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import threading
 
-# Watchdog: if the TPU tunnel wedges (observed in this sandbox), emit a
-# diagnostic line instead of hanging forever.  A daemon thread (not SIGALRM):
-# the hang sits inside native device-init code where signal handlers never
-# get a chance to run, but GIL-releasing native waits let threads proceed.
+# Watchdog: if the TPU tunnel wedges (observed in this sandbox), emit the
+# last committed on-chip measurement (marked stale) instead of hanging
+# forever.  A daemon thread (not SIGALRM): the hang sits inside native
+# device-init code where signal handlers never get a chance to run, but
+# GIL-releasing native waits let threads proceed.
 WATCHDOG_SECS = int(os.environ.get("BENCH_WATCHDOG_SECS", "900"))
+# Fast pre-probe: a subprocess that just initializes jax.devices().  The
+# observed tunnel failure mode black-holes device init, so a healthy chip
+# answers in seconds while a wedged tunnel times out — fail in ~1 min, not
+# after the full watchdog window.
+PROBE_SECS = int(os.environ.get("BENCH_PROBE_SECS", "75"))
+LAST_ONCHIP = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_results", "last_onchip.json")
+
+
+def _emit_stale(reason: str) -> None:
+    """Emit the last committed on-chip result, marked stale, as the one
+    JSON line — an outage should degrade the artifact to 'old but real',
+    never to zero signal (rounds 1-4 shipped four empty artifacts).
+
+    Always exits 2: a stale line is informative to the driver artifact
+    (which records stdout regardless of exit code) but must read as a
+    failure to exit-code consumers — scripts/tpu_recovery_watch.sh gates
+    its 'on-chip headline' commit on rc==0, and yesterday's number must
+    never be committed as a fresh measurement."""
+    try:
+        with open(LAST_ONCHIP) as f:
+            last = json.load(f)
+        last.setdefault("detail", {})
+        last["detail"]["stale"] = True
+        last["detail"]["stale_reason"] = reason
+        last["detail"]["measured_at"] = last.pop("measured_at", "unknown")
+        last["detail"]["provenance"] = last.pop("provenance", "")
+        print(json.dumps(last))
+    except Exception as e:  # no fallback snapshot — zero line, still rc=2
+        print(
+            json.dumps(
+                {
+                    "metric": "bench watchdog",
+                    "value": 0,
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": 0,
+                    "detail": {"error": reason, "fallback_error": repr(e)},
+                }
+            )
+        )
+    sys.stdout.flush()
+    os._exit(2)
+
+
+def _probe_device() -> tuple:
+    """Initialize jax.devices() in a throwaway subprocess; return
+    (platform, error) — platform '' means init failed, with error saying
+    whether it timed out (tunnel down) or crashed (env/config bug, which
+    waiting out an outage will not fix).  Runs with the parent's env so it
+    exercises the same PJRT plugin path the real run will."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_SECS,
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1], ""
+        tail = (out.stderr or "").strip().splitlines()[-3:]
+        return "", (f"device-init probe exited rc={out.returncode} "
+                    f"without a device: {' | '.join(tail)}")
+    except subprocess.TimeoutExpired:
+        return "", (f"device init did not answer within {PROBE_SECS}s "
+                    "pre-probe (TPU tunnel down)")
+    except OSError as e:
+        return "", f"device-init probe failed to launch: {e!r}"
 
 
 def _watchdog():
-    print(
-        json.dumps(
-            {
-                "metric": "bench watchdog",
-                "value": 0,
-                "unit": "tokens/sec/chip",
-                "vs_baseline": 0,
-                "detail": {"error": f"no result within {WATCHDOG_SECS}s (TPU tunnel stalled?)"},
-            }
-        )
-    )
-    sys.stdout.flush()
-    os._exit(2)
+    _emit_stale(f"no result within {WATCHDOG_SECS}s (TPU tunnel stalled mid-run)")
 
 
 # Named benchmark configs (BASELINE.md's benchmark list).  "magnitude"
@@ -86,29 +150,45 @@ def main() -> None:
         remat=True, remat_policy=policy, rank=128, loss_impl=loss_impl,
         dropout=dropout, **cfg
     )
-    print(
-        json.dumps(
-            {
-                "metric": f"{_CFG_NAME} ReLoRA r=128 seq{_CFG['seq']} bf16 "
-                "training throughput",
-                "value": res["tokens_per_sec"],
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(res["mfu"] / 0.5, 4),
-                "detail": {
-                    "mfu": res["mfu"],
-                    "step_time_s": res["step_time_s"],
-                    "tokens_per_update": res["tokens_per_update"],
-                    "loss": res["loss"],
-                    "device": res["device"],
-                    "config": _CFG_NAME,
-                    "remat_policy": policy,
-                },
-            }
-        )
-    )
+    line = {
+        "metric": f"{_CFG_NAME} ReLoRA r=128 seq{_CFG['seq']} bf16 "
+        "training throughput",
+        "value": res["tokens_per_sec"],
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(res["mfu"] / 0.5, 4),
+        "detail": {
+            "mfu": res["mfu"],
+            "step_time_s": res["step_time_s"],
+            "tokens_per_update": res["tokens_per_update"],
+            "loss": res["loss"],
+            "device": res["device"],
+            "config": _CFG_NAME,
+            "remat_policy": policy,
+        },
+    }
+    print(json.dumps(line))
+    # Refresh the stale-fallback snapshot so the next outage serves the
+    # freshest real measurement (committed alongside the round's results).
+    if "cpu" not in str(res["device"]).lower():
+        try:
+            import datetime
+
+            snap = dict(line)
+            snap["measured_at"] = datetime.date.today().isoformat()
+            snap["provenance"] = "bench.py on-chip run"
+            with open(LAST_ONCHIP, "w") as f:
+                json.dump(snap, f, indent=2)
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":
+    if os.environ.get("BENCH_FORCE") != "1":
+        platform, err = _probe_device()
+        if not platform:
+            _emit_stale(err)
+        if platform == "cpu":
+            _emit_stale("no accelerator (cpu-only jax backend)")
     timer = threading.Timer(WATCHDOG_SECS, _watchdog)
     timer.daemon = True
     timer.start()

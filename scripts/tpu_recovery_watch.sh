@@ -55,7 +55,7 @@ echo "tunnel UP $(date -u +%FT%TZ)"
 
 # 1. headline bench
 BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_local.json" 2>/tmp/bench_r4.err \
-  && commit "On-chip headline bench (r4 local)" -- "$RES/BENCH_r4_local.json"
+  && commit "On-chip headline bench (r4 local)" -- "$RES/BENCH_r4_local.json" "$RES/last_onchip.json"
 
 # 2. lever sweep: the unmeasured big levers first
 # Queue = the configs tools/plan_memory says FIT a 16 GB v5e at 1B/seq1024
@@ -106,7 +106,7 @@ if [ -n "$BEST" ]; then
     BENCH_LOSS_IMPL="$BEST_LOSS" BENCH_DROPOUT="$BEST_DROPOUT" \
     BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
     > "$RES/BENCH_r4_local_${BEST_POLICY}.json" 2>/dev/null \
-    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT)" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json"
+    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT)" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
 fi
 
 # 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
