@@ -31,6 +31,12 @@ from flax import linen as nn
 
 from relora_tpu.core.relora import LoraSpec, kaiming_uniform
 
+import logging
+
+# (module name, width) pairs already warned about the nf4->int8 fallback —
+# the warning should fire once per projection, not on every trace
+_NF4_FALLBACK_WARNED: set = set()
+
 
 class LoRALinear(nn.Module):
     """Dense layer with optional LoRA factors as first-class pytree leaves.
@@ -66,6 +72,18 @@ class LoRALinear(nn.Module):
             # mixed base merges correctly (bnb instead pads the flattened
             # tensor, reference relora.py:222-238)
             quantize = "int8"
+            key = (self.name, in_features)
+            if key not in _NF4_FALLBACK_WARNED:
+                # once per module/width at trace time: the user asked for
+                # nf4 but this projection stores int8 (2x the bytes) —
+                # memory/accuracy comparisons against pure-nf4 expectations
+                # would otherwise misattribute the difference
+                _NF4_FALLBACK_WARNED.add(key)
+                logging.getLogger(__name__).warning(
+                    "nf4 requested but in_features=%d is odd for module %r; "
+                    "storing this base as int8 (plan_memory accounts for it)",
+                    in_features, self.name,
+                )
         if quantize == "int8":
             from relora_tpu.ops.quant import dequantize_int8
 

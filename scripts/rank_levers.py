@@ -120,26 +120,44 @@ def _shape(t: str):
 
 
 def analyze(hlo_text: str) -> dict:
-    """Count dot_generals and sum their FLOPs from the StableHLO text."""
+    """Count dot_generals and sum their FLOPs from the StableHLO text.
+
+    The contracting-dims attribute is parsed from the pretty-printed
+    StableHLO line; that format is jax-version-sensitive (the generic form
+    prints ``#stablehlo.dot<lhs_contracting_dimensions=...>``).  A parse
+    miss silently defaulting K to 1 would undercount matmul FLOPs
+    massively and skew the lever ranking, so the analysis fails loudly if
+    any dot_general line lacks a parseable contracting-dims attribute (an
+    empty-list match is a legal outer product, priced k=1, not a miss)."""
     n = 0
     flops = 0.0
+    unparsed = 0
     for m in _DOT_RE.finditer(hlo_text):
         lhs, _rhs, out = _shape(m.group(1))[0], _shape(m.group(2))[0], _shape(m.group(3))
         out_dims, _ = out
         # find the contracting dims on the same line for the K factor
         line = m.group(0)
         dm = _DIMS_RE.search(line)
-        if dm and dm.group(1).strip():
+        if dm:
+            # an empty matched list is a legal zero-contracting-dim dot
+            # (outer product): K=1 is exactly right, not a parse miss
             k = 1
             for idx in (int(x) for x in dm.group(1).split(",") if x.strip()):
                 k *= lhs[idx]
         else:
             k = 1
+            unparsed += 1
         size_out = 1
         for d in out_dims:
             size_out *= d
         n += 1
         flops += 2.0 * size_out * k
+    if unparsed:
+        raise RuntimeError(
+            f"{unparsed}/{n} dot_general lines had no parseable "
+            "contracting_dims (StableHLO print format changed?) — FLOP "
+            "counts would be bogus; update _DIMS_RE for this jax version"
+        )
     return {"dots": n, "dot_gflops": round(flops / 1e9, 2)}
 
 

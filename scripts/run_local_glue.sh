@@ -52,7 +52,8 @@ run_one() { # run_one <task> <backbone-name> <checkpoint-or-->
     --output_dir "$out" --overwrite_output_dir true; then
     rm -f "$out/FAILED"
   else
-    mkdir -p "$out"; echo "exit=$? $(date -u +%FT%TZ)" >> "$out/FAILED"
+    local rc=$?
+    mkdir -p "$out"; echo "exit=$rc $(date -u +%FT%TZ)" >> "$out/FAILED"
   fi
 }
 

@@ -113,15 +113,19 @@ def task_locdoc(docs, rng, total):
 
 def task_locpair(docs, rng, total):
     """same-doc vs cross-doc segment pairs, balanced."""
+    if len(docs) < 2:
+        raise ValueError("locpair needs at least 2 docs to draw cross-doc negatives")
     rows = []
     for i, (_, segs) in enumerate(docs):
         if len(rows) >= total:
             break
         a, b = rng.sample(segs, 2)
         rows.append({"sentence1": a, "sentence2": b, "label": 1})
+        # re-draw until the 'other' doc differs: skipping the negative here
+        # would drift the pair task off 50/50 balance
         other = docs[rng.randrange(len(docs))]
-        if other[1] is segs:
-            continue
+        while other[1] is segs:
+            other = docs[rng.randrange(len(docs))]
         rows.append({"sentence1": rng.choice(segs), "sentence2": rng.choice(other[1]), "label": 0})
     rng.shuffle(rows)
     return rows[:total], ("sentence1", "sentence2", "label")
