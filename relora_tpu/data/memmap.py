@@ -57,6 +57,28 @@ def index_path(prefix: str) -> str:
     return prefix + ".idx"
 
 
+def _read_index_arrays(prefix: str):
+    """Parse just the ``.idx`` header + arrays: (dtype, sizes, doc_idx).
+
+    Unlike MemmapTokenDataset this never touches the ``.bin`` file, so it
+    works on empty shards and holds no mappings open."""
+    with open(index_path(prefix), "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{index_path(prefix)}: bad magic {magic!r}")
+        (version,) = struct.unpack("<Q", f.read(8))
+        if version != _VERSION:
+            raise ValueError(f"unsupported index version {version}")
+        (code,) = struct.unpack("<B", f.read(1))
+        dtype = np.dtype(_CODE_TO_DTYPE[code])
+        (n_seqs,) = struct.unpack("<Q", f.read(8))
+        (n_docs,) = struct.unpack("<Q", f.read(8))
+        sizes = np.frombuffer(f.read(n_seqs * 4), dtype=np.int32)
+        f.seek(n_seqs * 8, os.SEEK_CUR)  # skip the byte-offset pointers
+        doc_idx = np.frombuffer(f.read(n_docs * 8), dtype=np.int64)
+    return dtype, sizes, doc_idx
+
+
 class MemmapTokenDataset:
     """Read-only mmap view of a tokenized corpus.
 
@@ -203,8 +225,14 @@ class LegacyIndexedWriter:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.finalize()
+    def __exit__(self, exc_type, exc, tb):
+        # finalize only on clean exit: writing a valid-looking .idx over a
+        # partially-streamed .bin would leave a silently truncated corpus
+        # that downstream pipelines could load and train on
+        if exc_type is None:
+            self.finalize()
+        else:
+            self._bin.close()
 
 
 def open_token_dataset(prefix: str, impl: str = "infer"):
@@ -244,6 +272,39 @@ class MemmapTokenWriter:
         self._sizes.append(len(arr))
         self._doc_ends.append(len(self._sizes))
 
+    def merge_file(self, prefix: str) -> None:
+        """Append an already-written corpus shard wholesale (parity:
+        MMapIndexedDatasetBuilder.merge_file_, indexed_dataset.py:596-603).
+
+        The shard's raw ``.bin`` bytes are streamed onto this writer's data
+        file and its sizes/doc boundaries grafted onto the index, so merging
+        pre-tokenized shards never re-encodes tokens.  Only the shard's
+        ``.idx`` arrays are parsed (no memmap of the data file), so an
+        empty shard — a per-worker pretokenizer output that received no
+        documents — merges as a no-op instead of crashing."""
+        import shutil
+
+        if os.path.realpath(os.path.abspath(prefix)) == os.path.realpath(
+            os.path.abspath(self.prefix)
+        ):
+            raise ValueError(
+                f"cannot merge a corpus into itself ({prefix!r}): the "
+                "writer already truncated this prefix's .bin"
+            )
+        dtype, sizes, doc_idx = _read_index_arrays(prefix)
+        if dtype != self.dtype:
+            raise ValueError(
+                f"cannot merge {prefix!r} ({dtype}) into a "
+                f"{self.dtype} corpus — re-tokenize or migrate the shard"
+            )
+        base = len(self._sizes)
+        self._sizes.extend(int(s) for s in sizes)
+        # doc_idx[0] is the leading 0 sentinel — already represented by
+        # this writer's current end marker
+        self._doc_ends.extend(base + int(d) for d in doc_idx[1:])
+        with open(data_path(prefix), "rb") as f:
+            shutil.copyfileobj(f, self._bin)
+
     def finalize(self) -> None:
         self._bin.close()
         sizes = np.asarray(self._sizes, dtype=np.int32)
@@ -263,5 +324,11 @@ class MemmapTokenWriter:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.finalize()
+    def __exit__(self, exc_type, exc, tb):
+        # finalize only on clean exit: writing a valid-looking .idx over a
+        # partially-streamed .bin would leave a silently truncated corpus
+        # that downstream pipelines could load and train on
+        if exc_type is None:
+            self.finalize()
+        else:
+            self._bin.close()

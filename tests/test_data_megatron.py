@@ -2,6 +2,8 @@
 differential oracles (the reference's own strategy — SURVEY.md §2.2), packed
 sample semantics, blending, split parsing, resume rewind."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -51,6 +53,99 @@ def test_memmap_roundtrip(tmp_path):
         np.asarray(ds.get(3, offset=2, length=3)), docs[3][2:5].astype(np.uint16)
     )
     assert ds.n_tokens == sum(len(d) for d in docs)
+
+
+def test_memmap_merge_file(tmp_path):
+    """merge_file grafts shards bit-exactly (parity:
+    MMapIndexedDatasetBuilder.merge_file_, indexed_dataset.py:596-603)."""
+    pa, docs_a = write_corpus(tmp_path / "a", n_docs=7, seed=1)
+    pb, docs_b = write_corpus(tmp_path / "b", n_docs=11, seed=2)
+    out = str(tmp_path / "merged")
+    with MemmapTokenWriter(out, dtype=np.uint16) as w:
+        w.merge_file(pa)
+        w.add_document(np.arange(13))  # interleaved direct writes still work
+        w.merge_file(pb)
+    ds = MemmapTokenDataset(out)
+    expect = docs_a + [np.arange(13)] + docs_b
+    assert len(ds) == len(expect)
+    for i, doc in enumerate(expect):
+        np.testing.assert_array_equal(np.asarray(ds[i]), doc.astype(np.uint16))
+    # doc boundaries: one per document plus the leading sentinel,
+    # monotonically increasing through the graft points
+    np.testing.assert_array_equal(ds.doc_idx, np.arange(len(expect) + 1))
+    # the merged .bin is the exact byte concatenation of its sources
+    from relora_tpu.data.memmap import data_path
+
+    with open(data_path(out), "rb") as f:
+        merged_bytes = f.read()
+    with open(data_path(pa), "rb") as f:
+        assert merged_bytes.startswith(f.read())
+    with open(data_path(pb), "rb") as f:
+        assert merged_bytes.endswith(f.read())
+
+
+def test_memmap_merge_file_dtype_mismatch(tmp_path):
+    pa, _ = write_corpus(tmp_path / "a", n_docs=3, vocab=1000)  # uint16
+    with MemmapTokenWriter(str(tmp_path / "m"), dtype=np.int32) as w:
+        with pytest.raises(ValueError, match="cannot merge"):
+            w.merge_file(pa)
+        w.add_document(np.arange(4))  # writer still usable after the error
+
+
+def test_memmap_merge_empty_shard(tmp_path):
+    """A pretokenizer worker that received no documents produces an empty
+    shard; merging it must be a no-op, not a crash."""
+    empty = str(tmp_path / "empty")
+    with MemmapTokenWriter(empty, dtype=np.uint16):
+        pass
+    pa, docs_a = write_corpus(tmp_path / "a", n_docs=3)
+    out = str(tmp_path / "m")
+    with MemmapTokenWriter(out, dtype=np.uint16) as w:
+        w.merge_file(empty)
+        w.merge_file(pa)
+    ds = MemmapTokenDataset(out)
+    assert len(ds) == len(docs_a)
+    np.testing.assert_array_equal(np.asarray(ds[0]), docs_a[0].astype(np.uint16))
+
+
+def test_memmap_merge_self_guard(tmp_path):
+    pa, _ = write_corpus(tmp_path / "a", n_docs=3)
+    w = MemmapTokenWriter(pa + "_new", dtype=np.uint16)
+    with pytest.raises(ValueError, match="itself"):
+        # spelled differently but resolving to the writer's own prefix
+        w.merge_file(os.path.join(os.path.dirname(pa), ".", os.path.basename(pa) + "_new"))
+    w._bin.close()
+
+
+def test_memmap_writer_aborts_on_exception(tmp_path):
+    """A with-block that raises must NOT leave a loadable .idx behind —
+    a valid-looking index over a partial .bin is a silently truncated
+    corpus (reviewer finding, round 5)."""
+    out = str(tmp_path / "m")
+    with pytest.raises(RuntimeError):
+        with MemmapTokenWriter(out, dtype=np.uint16) as w:
+            w.add_document(np.arange(5))
+            raise RuntimeError("mid-stream failure")
+    assert not os.path.exists(out + ".idx")
+    with pytest.raises((ValueError, FileNotFoundError)):
+        MemmapTokenDataset(out)
+
+
+def test_merge_corpus_cli(tmp_path):
+    pa, docs_a = write_corpus(tmp_path / "a", n_docs=4, seed=3)
+    pb, docs_b = write_corpus(tmp_path / "b", n_docs=5, seed=4)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "merge_corpus", os.path.join(os.path.dirname(__file__), "..", "tools", "merge_corpus.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "merged")
+    mod.main([pa, pb, "--out", out])
+    ds = MemmapTokenDataset(out)
+    assert len(ds) == len(docs_a) + len(docs_b)
+    np.testing.assert_array_equal(np.asarray(ds[5]), docs_b[1].astype(np.uint16))
 
 
 def test_native_helpers_compile():

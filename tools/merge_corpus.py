@@ -1,0 +1,62 @@
+"""Merge pre-tokenized mmap corpus shards into one corpus.
+
+The reference exposes this as MMapIndexedDatasetBuilder.merge_file_
+(peft_pretraining/megatron_dataset/indexed_dataset.py:596-603), used to
+combine per-worker pretokenizer outputs.  Here the same capability is a
+one-shot CLI over MemmapTokenWriter.merge_file: raw ``.bin`` bytes are
+streamed, never re-encoded, so merging is IO-bound.
+
+Usage::
+
+    python tools/merge_corpus.py --out merged shard_a shard_b shard_c
+
+Each positional argument is a corpus prefix (``<prefix>.bin``/``.idx``).
+Shards must share a dtype (the pretokenizer autoselects by vocab size, so
+shards from one tokenizer always match).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("shards", nargs="+", help="input corpus prefixes (no extension)")
+    p.add_argument("--out", required=True, help="output corpus prefix")
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, ".")
+    from relora_tpu.data.memmap import (
+        MemmapTokenDataset,
+        MemmapTokenWriter,
+        _read_index_arrays,
+    )
+
+    # realpath comparison: a spelling variant like ./b for b would pass a
+    # string check, and the writer truncates out's .bin on open — catching
+    # it after that destroys the input shard
+    out_real = os.path.realpath(os.path.abspath(args.out))
+    for shard in args.shards:
+        if os.path.realpath(os.path.abspath(shard)) == out_real:
+            p.error(f"--out must not be one of the input shards ({shard!r})")
+
+    dtype, _, _ = _read_index_arrays(args.shards[0])
+    t0 = time.time()
+    with MemmapTokenWriter(args.out, dtype=dtype) as w:
+        for shard in args.shards:
+            w.merge_file(shard)
+
+    merged = MemmapTokenDataset(args.out)
+    print(
+        f"merged {len(args.shards)} shards -> {args.out}.bin/.idx: "
+        f"{len(merged):,} sequences / {merged.n_tokens:,} tokens "
+        f"({dtype}) in {time.time()-t0:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()
