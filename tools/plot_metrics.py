@@ -8,11 +8,17 @@ one or more runs with merge/reset markers and optional smoothing::
     python tools/plot_metrics.py curves ckpts/relora ckpts/full --ema 0.98
 
 ``scaling`` (notebook 03_scaling_laws_plotting): final loss vs trainable
-params (log-log) per run group, with a least-squares power-law fit
-``loss = a * params^b`` per group (full-rank vs ReLoRA, split on use_peft
-from each run's run_config.json)::
+params — or vs training compute C=6·N·D with ``--x compute`` — log-log per
+run group, with a least-squares power-law fit ``loss = a * x^b`` per group
+(full-rank vs ReLoRA, split on use_peft from each run's run_config.json).
+Inputs are run dirs, or ``metrics.jsonl:model_config:group`` triplets for
+committed sweep artifacts that carry no run_config.json; ``--fit-out``
+writes the fits as JSON::
 
     python tools/plot_metrics.py scaling ckpts/run_* --out scaling.png
+    python tools/plot_metrics.py scaling \
+        bench_results/r3_loss_parity_cpu_metrics/full_rank.jsonl:llama_9m:full_rank \
+        ... --x compute --fit-out scaling_fit.json
 
 ``lr`` (notebook 04_plot_lr): preview any supported schedule's LR curve
 without running anything — the schedules are the real ones from
@@ -122,36 +128,126 @@ def fit_power_law(xs, ys):
 
 
 def final_eval_loss(rows) -> float:
-    """Last eval loss if the run recorded any, else min smoothed train loss."""
+    """The run's final_eval_loss if recorded, else the last eval_loss, else
+    the mean of the last 20 train losses."""
+    finals = [r for r in rows if r.get("final_eval_loss") is not None]
+    if finals:
+        return float(finals[-1]["final_eval_loss"])
     evals = [r for r in rows if r.get("eval_loss") is not None]
     if evals:
         return float(evals[-1]["eval_loss"])
-    tail = [r["loss"] for r in rows[-20:]]
+    tail = [r["loss"] for r in rows if "loss" in r][-20:]
     return float(sum(tail) / len(tail))
+
+
+def _zoo_param_count_m(model_name: str) -> float:
+    """Exact full-rank parameter count for a MODEL_ZOO entry, in millions.
+
+    Shape-only (jax.eval_shape) — no weights are materialized, so this is
+    cheap even for the 1B/7B entries.  Used for metrics files recorded
+    without a run_config.json sidecar (e.g. the committed loss-parity
+    sweeps): compute-axis scaling needs N, and the 6·N·D FLOP estimate uses
+    the same total-N for full-rank and ReLoRA runs (frozen weights still
+    do forward+backward work)."""
+    import jax
+    import jax.numpy as jnp
+
+    from relora_tpu.config.model import MODEL_ZOO
+    from relora_tpu.models import LlamaForCausalLM
+    from relora_tpu.models.pythia import GPTNeoXForCausalLM
+
+    mc = MODEL_ZOO[model_name]
+    cls = GPTNeoXForCausalLM if mc.family == "neox" else LlamaForCausalLM
+    model = cls(config=mc, scan_layers=False)
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.PRNGKey(0),
+    )
+    return sum(
+        math.prod(l.shape) for l in jax.tree_util.tree_leaves(shapes)
+    ) / 1e6
+
+
+def _parse_scaling_entry(entry: str):
+    """A scaling input is a run dir, or ``metrics.jsonl:model_config:group``
+    for bare metrics files (committed sweep artifacts carry no
+    run_config.json).  Returns (rows, trainable_M, total_M, group, label)
+    or None when the entry lacks what the fit needs."""
+    if ":" in entry and entry.split(":", 1)[0].endswith(".jsonl"):
+        parts = entry.split(":")
+        if len(parts) != 3:
+            print(f"skipping {entry}: expected metrics.jsonl:model_config:group")
+            return None
+        path, model_name, group = parts
+        rows = [json.loads(l) for l in open(path)]
+        rows = [
+            r for r in rows
+            if ("loss" in r and "update_step" in r)
+            or r.get("final_eval_loss") is not None
+        ]
+        if not rows:
+            print(f"skipping {entry}: no usable loss rows")
+            return None
+        try:
+            n = _zoo_param_count_m(model_name)
+        except KeyError:
+            print(f"skipping {entry}: unknown model config {model_name!r}")
+            return None
+        # bare files carry no LoRA breakdown: N is the base model count
+        # (exact for full-rank; for ReLoRA entries use --x compute, where
+        # base-N is the right N anyway)
+        return rows, n, n, group, path
+    rows = load_metrics(entry)
+    cfg = load_run_config(entry)
+    if not rows or "trainable_params" not in cfg:
+        print(f"skipping {entry}: missing metrics or run_config.json trainable_params")
+        return None
+    group = "relora" if cfg.get("use_peft") else "full_rank"
+    # run_config.json stores param counts already in millions
+    # (trainer.py writes counts / 1e6), matching the axis label and the
+    # printed params_M fit — no further scaling.  Compute-axis N is
+    # equivalent_params (base model, LoRA folded out) so run dirs and bare
+    # triplets put identical compute at identical x.
+    total_m = float(cfg.get("equivalent_params") or cfg["total_params"])
+    return rows, float(cfg["trainable_params"]), total_m, group, entry
+
+
+def _final_tokens(rows) -> float:
+    toks = [r["tokens_seen"] for r in rows if r.get("tokens_seen")]
+    return float(toks[-1]) if toks else 0.0
 
 
 def cmd_scaling(argv) -> None:
     p = argparse.ArgumentParser(prog="plot_metrics.py scaling")
-    p.add_argument("run_dirs", nargs="+")
+    p.add_argument("run_dirs", nargs="+",
+                   help="run dirs, or metrics.jsonl:model_config:group triplets")
     p.add_argument("--out", default="scaling.png")
+    p.add_argument("--x", choices=("params", "compute"), default="params",
+                   help="x axis: trainable params (M) or training compute "
+                        "C = 6*N*D FLOPs (notebook 03's loss-vs-compute view)")
+    p.add_argument("--fit-out", default=None,
+                   help="write the per-group power-law fits as JSON")
     args = p.parse_args(argv)
     plt = _mpl()
 
     groups: dict = {}
-    for run_dir in args.run_dirs:
-        rows = load_metrics(run_dir)
-        cfg = load_run_config(run_dir)
-        if not rows or "trainable_params" not in cfg:
-            print(f"skipping {run_dir}: missing metrics or run_config.json trainable_params")
+    for entry in args.run_dirs:
+        parsed = _parse_scaling_entry(entry)
+        if parsed is None:  # reason already printed by the parser
             continue
-        group = "relora" if cfg.get("use_peft") else "full_rank"
-        # run_config.json stores param counts already in millions
-        # (trainer.py writes counts / 1e6), matching the axis label and the
-        # printed params_M fit — no further scaling
-        groups.setdefault(group, []).append(
-            (float(cfg["trainable_params"]), final_eval_loss(rows), run_dir)
-        )
+        rows, trainable_m, total_m, group, label = parsed
+        if args.x == "compute":
+            d = _final_tokens(rows)
+            if d == 0:
+                print(f"skipping {label}: no tokens_seen recorded")
+                continue
+            x = 6.0 * total_m * 1e6 * d  # FLOPs
+        else:
+            x = trainable_m
+        groups.setdefault(group, []).append((x, final_eval_loss(rows), label))
 
+    xname = "compute C=6·N·D (FLOPs)" if args.x == "compute" else "params_M"
+    fits = {}
     fig, ax = plt.subplots(figsize=(5.5, 5.5))
     for group, pts in sorted(groups.items()):
         xs = [p[0] for p in pts]
@@ -162,16 +258,29 @@ def cmd_scaling(argv) -> None:
             grid = [min(xs) * (max(xs) / min(xs)) ** (i / 99) for i in range(100)]
             ax.plot(grid, [a * x**b for x in grid], linestyle="--", alpha=0.7,
                     label=f"{group}: {a:.2f}·x^{b:.3f}")
-            print(f"{group}: loss = {a:.4f} * params_M^{b:.4f}  ({len(pts)} runs)")
+            print(f"{group}: loss = {a:.4g} * x^{b:.4f}  (x = {xname}, {len(pts)} runs)")
+            fits[group] = {
+                "a": a,
+                "b": b,
+                "x_axis": args.x,
+                "points": [
+                    {"x": x, "loss": y, "run": lbl} for x, y, lbl in pts
+                ],
+            }
     ax.set_xscale("log")
     ax.set_yscale("log")
-    ax.set_xlabel("Trainable parameters (M)")
+    ax.set_xlabel("Training compute (FLOPs)" if args.x == "compute"
+                  else "Trainable parameters (M)")
     ax.set_ylabel("Loss")
-    ax.set_title("Scaling: loss vs trainable params")
+    ax.set_title(f"Scaling: loss vs {'compute' if args.x == 'compute' else 'trainable params'}")
     ax.legend(fontsize=8)
     fig.tight_layout()
     fig.savefig(args.out, dpi=150)
     print(f"wrote {args.out}")
+    if args.fit_out:
+        with open(args.fit_out, "w") as f:
+            json.dump({"model": "loss = a * x^b", "fits": fits}, f, indent=2)
+        print(f"wrote {args.fit_out}")
 
 
 def cmd_lr(argv) -> None:
