@@ -88,10 +88,14 @@ def spearman_corr(a: np.ndarray, b: np.ndarray) -> float:
     return pearson_corr(rank(a), rank(b))
 
 
-def task_metrics(task: str, preds: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+def task_metrics(
+    task: str, preds: np.ndarray, labels: np.ndarray, num_labels: Optional[int] = None
+) -> Dict[str, float]:
     """The metric set evaluate.load("glue", task) would report
-    (parity: run_glue.py:496-501)."""
-    if task == "stsb":
+    (parity: run_glue.py:496-501).  ``num_labels == 1`` marks a custom
+    regression task (float-typed labels, the reference's dtype inference):
+    those report pearson/spearman like stsb."""
+    if task == "stsb" or num_labels == 1:
         return {
             "pearson": pearson_corr(preds, labels),
             "spearmanr": spearman_corr(preds, labels),
@@ -99,7 +103,9 @@ def task_metrics(task: str, preds: np.ndarray, labels: np.ndarray) -> Dict[str, 
     if task == "cola":
         return {"matthews_correlation": matthews_corr(preds, labels)}
     out = {"accuracy": accuracy(preds, labels)}
-    if task in ("mrpc", "qqp"):
+    # pair tasks report accuracy + F1 (GLUE's mrpc/qqp set; the local
+    # pair-shaped surrogates locpair/locnsp follow the same convention)
+    if task in ("mrpc", "qqp", "locpair", "locnsp"):
         out["f1"] = f1_binary(preds, labels)
     return out
 
@@ -221,7 +227,7 @@ def finetune(
             labels_all.append(labels)
         preds = np.concatenate(preds)
         labels_all = np.concatenate(labels_all)
-        metrics = task_metrics(gcfg.task, preds, labels_all)
+        metrics = task_metrics(gcfg.task, preds, labels_all, num_labels=num_labels)
         logger.info(f"{gcfg.task}: {metrics}")
 
     predictions = None

@@ -146,6 +146,35 @@ def main(argv=None):
             raw["test"] = read_split(args.test_file)
         cols = [c for c in raw[next(iter(raw))][0] if c != "label"]
         key1, key2 = cols[0], (cols[1] if len(cols) > 1 else None)
+        # infer regression from float-typed labels, the reference's
+        # behavior for user datasets (run_glue.py:392-398 checks the label
+        # feature dtype).  CSV labels are strings, so "float-typed" means
+        # every label parses as a float and at least one is not an integer
+        # literal — {"0","1"} stays classification, {"0.0","3.3"} is
+        # regression.
+        if not is_regression:
+            # empty label cells (an unlabeled CSV test split reads as "")
+            # are skipped per-row, not allowed to void the inference
+            seen = [
+                s
+                for split in raw.values()
+                for r in split
+                if (s := str(r.get("label", "")).strip())
+            ]
+
+            def _as_float(s: str):
+                try:
+                    return float(s)
+                except ValueError:
+                    return None
+
+            vals = [_as_float(s) for s in seen]
+            # decimal-literal check (not int(v) comparison: "inf"/"nan"
+            # would overflow or false-positive) — {"0","1"} stays
+            # classification, {"0.0","3.3","1e-1"} is regression
+            is_regression = bool(seen) and all(v is not None for v in vals) and any(
+                "." in s or "e" in s.lower() for s in seen
+            )
     else:
         import datasets
 

@@ -26,7 +26,7 @@ EPOCHS="${EPOCHS:-3}"
 BATCH="${BATCH:-16}"
 LR="${LR:-5e-5}"
 MAXLEN="${MAXLEN:-128}"
-TASKS="${TASKS:-locdoc locpair locorder}"
+TASKS="${TASKS:-locdoc locpair locorder locsim locnsp}"
 
 mkdir -p "$WORK" "$(dirname "$OUT_JSON")"
 

@@ -120,6 +120,89 @@ def test_cli_predict_only_unlabeled_test(tmp_path, model_json):
     assert all(line.split("\t")[1] in ("pos", "neg") for line in preds[1:])
 
 
+@pytest.mark.slow
+def test_cli_regression_float_labels(tmp_path, model_json):
+    """Float-typed labels switch a custom task to regression (the
+    reference's dtype inference, run_glue.py:392-398): num_labels=1 MSE
+    head, pearson/spearman metrics, float predictions.  The signal is a
+    token the label depends on linearly, so pearson must go high."""
+    import run_glue
+
+    tok = _write_tokenizer(tmp_path / "tok.json")
+    # label tracks which of two separable vocabularies dominates
+    rows = []
+    for i in range(48):
+        hot = i % 5
+        words = ["alpha"] * hot + ["delta"] * (4 - hot)
+        rows.append({"sentence": " ".join(words), "label": str(hot * 1.25)})
+    paths = {}
+    for name, chunk in (("train", rows[:32]), ("validation", rows[32:40]), ("test", rows[40:])):
+        p = tmp_path / f"{name}.json"
+        with open(p, "w") as f:
+            for r in chunk:
+                f.write(json.dumps(r) + "\n")
+        paths[name] = str(p)
+    out = tmp_path / "out"
+    run_glue.main(
+        [
+            "--task_name", "synthreg",
+            "--model_config", model_json,
+            "--tokenizer", tok,
+            "--train_file", paths["train"],
+            "--validation_file", paths["validation"],
+            "--test_file", paths["test"],
+            "--do_train", "true", "--do_eval", "true", "--do_predict", "true",
+            "--num_train_epochs", "6",
+            "--learning_rate", "5e-3",
+            "--max_seq_length", "16",
+            "--output_dir", str(out),
+            "--seed", "0",
+        ]
+    )
+    results = json.load(open(out / "all_results.json"))
+    assert "eval_pearson" in results and "eval_spearmanr" in results, results
+    # the tiny model recovers the rank order exactly within a few epochs;
+    # its raw outputs are monotone-but-not-yet-linear, so pearson trails
+    assert results["eval_spearmanr"] >= 0.9, results
+    assert results["eval_pearson"] >= 0.5, results
+    preds = (out / "predict_results_synthreg.txt").read_text().splitlines()
+    assert len(preds) == 9
+    # regression predictions are floats, not label names
+    float(preds[1].split("\t")[1])
+
+
+def test_cli_int_labels_stay_classification(tmp_path, model_json):
+    """{"0","1"} string labels must NOT trip the regression inference."""
+    import run_glue
+
+    tok = _write_tokenizer(tmp_path / "tok.json")
+    paths = _write_splits(tmp_path)
+    # rewrite labels as integer strings
+    for name in ("train", "validation"):
+        rows = [json.loads(l) for l in open(paths[name])]
+        with open(paths[name], "w") as f:
+            for r in rows:
+                r["label"] = "1" if r["label"] == "pos" else "0"
+                f.write(json.dumps(r) + "\n")
+    out = tmp_path / "out"
+    run_glue.main(
+        [
+            "--task_name", "synthint",
+            "--model_config", model_json,
+            "--tokenizer", tok,
+            "--train_file", paths["train"],
+            "--validation_file", paths["validation"],
+            "--do_train", "true", "--do_eval", "true", "--do_predict", "false",
+            "--num_train_epochs", "1",
+            "--max_seq_length", "16",
+            "--output_dir", str(out),
+            "--seed", "0",
+        ]
+    )
+    results = json.load(open(out / "all_results.json"))
+    assert "eval_accuracy" in results and "eval_pearson" not in results, results
+
+
 def test_cli_unlabeled_only_raises(tmp_path, model_json):
     """All-unlabeled custom input fails loudly instead of KeyError."""
     import run_glue

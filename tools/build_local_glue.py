@@ -16,6 +16,13 @@ Three tasks, mirroring GLUE's task shapes:
 - ``locorder`` (CoLA shape)   single segment, binary: natural word order
   vs seeded word-shuffle.  Metric: accuracy (+F1; CoLA's Matthews is
   keyed to the task name "cola" in eval/glue.py:task_metrics).
+- ``locsim``   (STS-B shape)  segment pair, CONTINUOUS 0-5 label = 5x the
+  exact character-overlap fraction between the two windows.  Metrics:
+  pearson + spearman (run_glue.py infers regression from the float
+  labels, the reference's dtype rule).
+- ``locnsp``   (RTE shape)    short segment pair, binary: does sentence2
+  directly continue sentence1?  Negatives are same-doc-far or cross-doc.
+  Segments sized to survive seq-128 truncation.  Metric: accuracy + F1.
 
 Usage::
 
@@ -64,6 +71,7 @@ def build_pools(roots, max_mb: float, seed: int, need_per_class: int = 0):
     segments (or the roots are exhausted)."""
     rng = random.Random(seed)
     docs = []  # (is_code, [segments])
+    rawdocs = []  # (is_code, full_text) — for continuity/overlap tasks
     n_code = n_prose = 0
     harvested = 0
     for path, text in harvest(roots, 1 << 40):
@@ -72,6 +80,7 @@ def build_pools(roots, max_mb: float, seed: int, need_per_class: int = 0):
         if len(segs) >= 2:
             is_code = path.endswith(".py")
             docs.append((is_code, segs))
+            rawdocs.append((is_code, text))
             if is_code:
                 n_code += len(segs)
             else:
@@ -81,7 +90,8 @@ def build_pools(roots, max_mb: float, seed: int, need_per_class: int = 0):
         ):
             break
     rng.shuffle(docs)
-    return docs, rng
+    rng.shuffle(rawdocs)
+    return docs, rawdocs, rng
 
 
 def write_csv(path, rows, fields):
@@ -147,7 +157,88 @@ def task_locorder(docs, rng, total):
     return rows, ("sentence", "label")
 
 
+SIM_LEN = 200  # chars per side: a pair fits seq 128 (~500 chars of tokens),
+               # the truncation wall that made locpair chance-level at 128
+
+
+def _clean(s: str) -> str:
+    return " ".join(s.split())
+
+
+def task_locsim(rawdocs, rng, total):
+    """Graded-overlap similarity pairs, continuous 0-5 label (STS-B shape).
+
+    sentence2 is a window shifted to share an exact fraction f of
+    sentence1's characters; label = 5*f.  Half the f=0 pairs are cross-doc
+    (no shared text at all).  Lexical overlap is a real, learnable,
+    *continuous* signal, so pearson/spearman measure genuine regression
+    ability — the reference's stsb path (run_glue.py:57-67, 496-501)."""
+    texts = [t for _, t in rawdocs if len(t) >= 3 * SIM_LEN]
+    if len(texts) < 2:
+        raise ValueError("locsim needs at least 2 docs of >= 3*SIM_LEN chars")
+    fractions = (0.0, 0.2, 0.4, 0.6, 0.8, 1.0)
+    rows = []
+    while len(rows) < total:
+        t = texts[rng.randrange(len(texts))]
+        s = rng.randrange(0, len(t) - 2 * SIM_LEN)
+        a = _clean(t[s : s + SIM_LEN])
+        f = fractions[len(rows) % len(fractions)]  # uniform label coverage
+        if f == 0.0 and rng.random() < 0.5:
+            o = texts[rng.randrange(len(texts))]
+            while o is t:
+                o = texts[rng.randrange(len(texts))]
+            so = rng.randrange(0, len(o) - SIM_LEN)
+            b = _clean(o[so : so + SIM_LEN])
+        else:
+            shift = int(SIM_LEN * (1.0 - f))
+            b = _clean(t[s + shift : s + shift + SIM_LEN])
+        if len(a) < SIM_LEN // 2 or len(b) < SIM_LEN // 2:
+            continue
+        rows.append({"sentence1": a, "sentence2": b, "label": round(5.0 * f, 1)})
+    rng.shuffle(rows)
+    return rows, ("sentence1", "sentence2", "label")
+
+
+def task_locnsp(rawdocs, rng, total):
+    """Next-segment prediction, binary (RTE shape, short segments).
+
+    sentence2 either directly continues sentence1 (label 1) or is drawn
+    far away in the same doc / from another doc (label 0, half each) —
+    same-doc-far negatives force continuity understanding, not topic
+    matching.  Segments are SIM_LEN chars so pairs survive seq-128
+    truncation (locpair's 200-400-char segments did not)."""
+    texts = [t for _, t in rawdocs if len(t) >= 6 * SIM_LEN]
+    if len(texts) < 2:
+        raise ValueError("locnsp needs at least 2 docs of >= 6*SIM_LEN chars")
+    rows = []
+    while len(rows) < total:
+        t = texts[rng.randrange(len(texts))]
+        s = rng.randrange(0, len(t) - 2 * SIM_LEN)
+        a = _clean(t[s : s + SIM_LEN])
+        b_pos = _clean(t[s + SIM_LEN : s + 2 * SIM_LEN])
+        if len(a) < SIM_LEN // 2 or len(b_pos) < SIM_LEN // 2:
+            continue
+        rows.append({"sentence1": a, "sentence2": b_pos, "label": 1})
+        if rng.random() < 0.5:
+            far = rng.randrange(0, len(t) - SIM_LEN)
+            while abs(far - (s + SIM_LEN)) < 2 * SIM_LEN:
+                far = rng.randrange(0, len(t) - SIM_LEN)
+            b_neg = _clean(t[far : far + SIM_LEN])
+        else:
+            o = texts[rng.randrange(len(texts))]
+            while o is t:
+                o = texts[rng.randrange(len(texts))]
+            so = rng.randrange(0, len(o) - SIM_LEN)
+            b_neg = _clean(o[so : so + SIM_LEN])
+        rows.append({"sentence1": a, "sentence2": b_neg, "label": 0})
+    rng.shuffle(rows)
+    return rows[:total], ("sentence1", "sentence2", "label")
+
+
+# segment-pool tasks consume (is_code, [segments]); raw-text tasks consume
+# (is_code, full_text) — continuity and overlap need contiguous documents
 TASKS = {"locdoc": task_locdoc, "locpair": task_locpair, "locorder": task_locorder}
+RAW_TASKS = {"locsim": task_locsim, "locnsp": task_locnsp}
 
 
 def main(argv=None):
@@ -166,11 +257,11 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     total = args.train + args.eval + args.test
-    docs, rng = build_pools(args.roots, args.max_mb, args.seed, need_per_class=total // 2)
+    docs, rawdocs, rng = build_pools(args.roots, args.max_mb, args.seed, need_per_class=total // 2)
     print(f"harvested {len(docs)} documents")
     meta = {"roots": args.roots, "seed": args.seed, "n_docs": len(docs), "tasks": {}}
-    for name, fn in TASKS.items():
-        rows, fields = fn(docs, rng, total)
+    for name, fn in {**TASKS, **RAW_TASKS}.items():
+        rows, fields = fn(rawdocs if name in RAW_TASKS else docs, rng, total)
         sizes = (args.train, args.eval, args.test)
         if len(rows) < total:
             # a class pool ran dry (prose is scarce in python trees): keep
@@ -182,10 +273,14 @@ def main(argv=None):
         write_csv(os.path.join(tdir, "train.csv"), tr, fields)
         write_csv(os.path.join(tdir, "validation.csv"), ev, fields)
         write_csv(os.path.join(tdir, "test.csv"), te, fields)
+        kind = "continuous" if name == "locsim" else "binary"
         bal = sum(r["label"] for r in ev) / max(len(ev), 1)
+        stat = "eval_label_mean" if kind == "continuous" else "eval_label_balance"
         meta["tasks"][name] = {"train": len(tr), "validation": len(ev), "test": len(te),
-                               "eval_label_balance": round(bal, 3), "fields": list(fields)}
-        print(f"{name}: train={len(tr)} validation={len(ev)} test={len(te)} eval_pos_rate={bal:.3f}")
+                               stat: round(bal, 3), "fields": list(fields),
+                               "label_kind": kind}
+        print(f"{name}: train={len(tr)} validation={len(ev)} test={len(te)} "
+              + (f"eval_label_mean={bal:.3f}" if kind == "continuous" else f"eval_pos_rate={bal:.3f}"))
     with open(os.path.join(args.out, "meta.json"), "w") as f:
         json.dump(meta, f, indent=2)
 
