@@ -16,6 +16,7 @@ shardings make XLA insert the DDP/FSDP collectives.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -51,30 +52,17 @@ def _zigzag_inputs(tokens: jax.Array, ring: int):
     return tokens[:, perm], labels[:, perm], perm[None, :]
 
 
-def make_train_step(
+def _make_loss_fn(
     model,
-    tx: optax.GradientTransformation,
-    trainable_mask: PyTree,
     *,
-    clip_grad_norm: float = 1.0,
-    schedule: Optional[Callable] = None,
-    grad_breakdown: bool = False,
-    zigzag_ring: Optional[int] = None,
-    loss_impl: str = "dense",  # dense | chunked (streamed vocab CE)
+    loss_impl: str = "dense",
     vocab_chunk: int = 8192,
-    log_per_layer_scaling: bool = False,
-) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
-    """Build ``train_step(state, batch, rng) -> (state, metrics)``.
-
-    ``batch``: int32 token ids shaped ``(grad_accum, microbatch, seq)``.
-    With ``zigzag_ring`` set, the model runs in the zigzag sequence layout
-    (attention impl 'ring_zigzag'): tokens/positions/labels are permuted
-    consistently inside the step.  The returned function is pure; jit it
-    with donated state, e.g.::
-
-        step = jax.jit(make_train_step(...), donate_argnums=0)
-    """
-
+    zigzag_ring: Optional[int] = None,
+) -> Callable:
+    """``loss_fn(trainable, frozen, tokens, rng) -> loss`` shared by the
+    train step and the watch-histogram pass (one definition of the
+    training loss; the chunked path never materializes (B, S, vocab)
+    logits)."""
     if loss_impl not in ("dense", "chunked"):
         raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {loss_impl!r}")
 
@@ -114,6 +102,36 @@ def make_train_step(
         loss, _ = causal_lm_loss(logits, tokens_in, labels=labels)
         return loss
 
+    return loss_fn
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    trainable_mask: PyTree,
+    *,
+    clip_grad_norm: float = 1.0,
+    schedule: Optional[Callable] = None,
+    grad_breakdown: bool = False,
+    zigzag_ring: Optional[int] = None,
+    loss_impl: str = "dense",  # dense | chunked (streamed vocab CE)
+    vocab_chunk: int = 8192,
+    log_per_layer_scaling: bool = False,
+) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
+    """Build ``train_step(state, batch, rng) -> (state, metrics)``.
+
+    ``batch``: int32 token ids shaped ``(grad_accum, microbatch, seq)``.
+    With ``zigzag_ring`` set, the model runs in the zigzag sequence layout
+    (attention impl 'ring_zigzag'): tokens/positions/labels are permuted
+    consistently inside the step.  The returned function is pure; jit it
+    with donated state, e.g.::
+
+        step = jax.jit(make_train_step(...), donate_argnums=0)
+    """
+
+    loss_fn = _make_loss_fn(
+        model, loss_impl=loss_impl, vocab_chunk=vocab_chunk, zigzag_ring=zigzag_ring
+    )
     grad_fn = jax.value_and_grad(loss_fn)
 
     def train_step(state: TrainState, batch: jax.Array, rng: jax.Array):
@@ -259,3 +277,64 @@ def make_eval_step(
         return {"loss_sum": loss * n, "n_tokens": n}
 
     return eval_step
+
+
+def make_watch_histograms(
+    model,
+    trainable_mask: PyTree,
+    *,
+    n_bins: int = 64,
+    loss_impl: str = "dense",
+    vocab_chunk: int = 8192,
+    zigzag_ring: Optional[int] = None,
+):
+    """Parameter + gradient histograms per top-level subtree — the
+    observability ``wandb.watch(model)`` provided in the reference
+    (torchrun_main.py:624-627), as a pure jittable function run off the hot
+    path at watch cadence (the train step itself only carries the cheap
+    grad-norm breakdown).
+
+    Returns ``watch(params, tokens, rng) -> {"hist/param/<key>": (counts,
+    edges), "hist/grad/<key>": ...}`` where ``tokens`` is ONE microbatch
+    ``(micro, seq)``.  Gradients come from a dedicated backward pass using
+    the SAME loss as training (loss_impl/zigzag honored — a chunked-loss
+    config stays chunked here, its whole point is that dense logits don't
+    fit), so the histograms reflect raw per-parameter grads, not the
+    accumulated/clipped update.
+
+    Each subtree is histogrammed leaf-by-leaf against shared min/max
+    edges and the counts summed — no concatenated f32 copy of the whole
+    subtree (that transient would double the frozen base's footprint)."""
+    loss_fn = _make_loss_fn(
+        model, loss_impl=loss_impl, vocab_chunk=vocab_chunk, zigzag_ring=zigzag_ring
+    )
+
+    def hist_tree(tree: PyTree, prefix: str) -> dict:
+        out = {}
+        for key, sub in tree.items():
+            leaves = jax.tree_util.tree_leaves(sub)
+            if not leaves:
+                continue
+            lo = functools.reduce(
+                jnp.minimum, [l.min().astype(jnp.float32) for l in leaves]
+            )
+            hi = functools.reduce(
+                jnp.maximum, [l.max().astype(jnp.float32) for l in leaves]
+            )
+            hi = jnp.where(hi > lo, hi, lo + 1e-6)  # constant subtree (e.g. fresh B=0)
+            edges = lo + (hi - lo) * jnp.arange(n_bins + 1, dtype=jnp.float32) / n_bins
+            counts = sum(
+                jnp.histogram(l.ravel().astype(jnp.float32), bins=edges)[0]
+                for l in leaves
+            )
+            out[f"{prefix}{key}"] = (counts, edges)
+        return out
+
+    def watch(params: PyTree, tokens: jax.Array, rng: jax.Array) -> dict:
+        trainable, frozen = partition(params, trainable_mask)
+        grads = jax.grad(loss_fn)(trainable, frozen, tokens, rng)
+        out = hist_tree(params, "hist/param/")
+        out.update(hist_tree(grads, "hist/grad/"))
+        return out
+
+    return watch

@@ -53,7 +53,7 @@ from relora_tpu.parallel.mesh import (
 )
 from relora_tpu.train import checkpoint as ckpt
 from relora_tpu.train.state import TrainState
-from relora_tpu.train.step import make_eval_step, make_train_step
+from relora_tpu.train.step import make_eval_step, make_train_step, make_watch_histograms
 from relora_tpu.utils.logging import MetricsLogger, get_logger, set_process_index
 
 logger = get_logger(__name__)
@@ -324,6 +324,21 @@ class Trainer:
                 vocab_chunk=cfg.vocab_chunk,
             )
         )
+        # wandb.watch parity (torchrun_main.py:624-627): histograms run as
+        # their own compiled program at eval cadence, never in the hot step
+        self._watch_step = (
+            jax.jit(
+                make_watch_histograms(
+                    self.model,
+                    self.trainable_mask,
+                    loss_impl=cfg.loss_impl,
+                    vocab_chunk=cfg.vocab_chunk,
+                    zigzag_ring=zigzag_ring,
+                )
+            )
+            if cfg.wandb_watch
+            else None
+        )
         if self.lora_spec is not None:
             spec = self.lora_spec
             self._merge_fn = jax.jit(
@@ -575,6 +590,22 @@ class Trainer:
                     step=self.global_step,
                 )
                 logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
+
+            # ---- wandb.watch histograms (torchrun_main.py:624-627) -------
+            if (
+                self._watch_step is not None
+                and cfg.eval_every > 0
+                and self.update_step % cfg.eval_every == 0
+            ):
+                hists = self._watch_step(
+                    self.state.params,
+                    batch[0],
+                    jax.random.fold_in(rng, 2**30 + self.update_step),
+                )
+                self.metrics.log_histograms(
+                    {k: (v[0], v[1]) for k, v in hists.items()},
+                    step=self.global_step,
+                )
 
             # ---- ReLoRA merge (torchrun_main.py:874-893) ----------------
             relora_every = cfg.relora  # 0 normalized to None in finalize

@@ -134,6 +134,36 @@ class MetricsLogger:
         if self._wandb is not None:
             self._wandb.log(dict(metrics), step=step)
 
+    def log_histograms(self, hists: Mapping[str, Any], step: Optional[int] = None) -> None:
+        """wandb.watch-style histogram sink (torchrun_main.py:624-627):
+        ``hists`` maps name -> (counts, bin_edges).  JSONL gets the raw
+        arrays (offline dashboards re-render them); wandb gets native
+        Histogram objects."""
+        if not self.enabled or not hists:
+            return
+        record = {
+            k: {"counts": [int(c) for c in counts], "edges": [float(e) for e in edges]}
+            for k, (counts, edges) in hists.items()
+        }
+        if step is not None:
+            record["_step"] = step
+        record["_time"] = time.time()
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if self._wandb is not None:
+            import numpy as np
+
+            self._wandb.log(
+                {
+                    k: self._wandb.Histogram(
+                        np_histogram=(np.asarray(counts), np.asarray(edges))
+                    )
+                    for k, (counts, edges) in hists.items()
+                },
+                step=step,
+            )
+
     def alert(self, title: str, text: str) -> None:
         """Parity: wandb.alert on bad post-reset LR (training_utils.py:397-404)."""
         get_logger().warning(f"ALERT [{title}]: {text}")

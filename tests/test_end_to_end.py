@@ -304,3 +304,44 @@ def test_seed_determinism(tmp_path):
         outs.append(tr.state.params)
     for a, b in zip(jax.tree_util.tree_leaves(outs[0]), jax.tree_util.tree_leaves(outs[1])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_wandb_watch_histograms(tmp_path):
+    """--wandb_watch logs param+grad histograms at eval cadence (the
+    reference's wandb.watch observability, torchrun_main.py:624-627) plus
+    the per-subtree grad-norm breakdown in the step metrics."""
+    from relora_tpu.train.trainer import Trainer
+
+    cfg = make_cfg(
+        tmp_path, wandb_watch=True, eval_every=4, num_training_steps=8,
+        relora=None, cycle_length=8, scheduler="cosine",
+    )
+    data = FakeTokens(n=256)
+    trainer = Trainer(cfg, model_cfg=TINY)
+    train_factory, eval_factory = make_iterators(cfg, trainer, data)
+    trainer.fit(train_factory(), eval_factory)
+
+    hist_records = []
+    norm_records = []
+    with open(os.path.join(cfg.save_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if any(k.startswith("hist/") for k in rec):
+                hist_records.append(rec)
+            if any(k.startswith("grad_norm/") for k in rec):
+                norm_records.append(rec)
+    # eval cadence 4 over 8 steps -> histograms at steps 4 and 8
+    assert len(hist_records) == 2, [sorted(r) for r in hist_records]
+    rec = hist_records[-1]
+    param_keys = [k for k in rec if k.startswith("hist/param/")]
+    grad_keys = [k for k in rec if k.startswith("hist/grad/")]
+    assert param_keys and grad_keys, sorted(rec)
+    for k in param_keys + grad_keys:
+        h = rec[k]
+        assert len(h["edges"]) == len(h["counts"]) + 1
+        assert sum(h["counts"]) > 0
+        assert h["edges"][0] < h["edges"][-1]
+    # grads over trainable-only subtrees; params over the full tree
+    assert any("lora" in k.lower() or "layers" in k for k in grad_keys), grad_keys
+    assert norm_records, "grad_norm/* breakdown missing with wandb_watch"
