@@ -312,20 +312,35 @@ def make_watch_histograms(
     def hist_tree(tree: PyTree, prefix: str) -> dict:
         out = {}
         for key, sub in tree.items():
-            leaves = jax.tree_util.tree_leaves(sub)
+            leaves = [
+                l.ravel().astype(jnp.float32)
+                for l in jax.tree_util.tree_leaves(sub)
+            ]
             if not leaves:
                 continue
+            # min/max over FINITE values only: one NaN grad (the event the
+            # step's NaN gate deliberately survives) must not poison the
+            # edges into all-NaN and crash the wandb sink
+            fin = [jnp.isfinite(l) for l in leaves]
             lo = functools.reduce(
-                jnp.minimum, [l.min().astype(jnp.float32) for l in leaves]
+                jnp.minimum,
+                [jnp.min(jnp.where(f, l, jnp.inf)) for l, f in zip(leaves, fin)],
             )
             hi = functools.reduce(
-                jnp.maximum, [l.max().astype(jnp.float32) for l in leaves]
+                jnp.maximum,
+                [jnp.max(jnp.where(f, l, -jnp.inf)) for l, f in zip(leaves, fin)],
             )
-            hi = jnp.where(hi > lo, hi, lo + 1e-6)  # constant subtree (e.g. fresh B=0)
+            any_finite = jnp.isfinite(lo) & jnp.isfinite(hi)
+            lo = jnp.where(any_finite, lo, 0.0)
+            hi = jnp.where(any_finite & (hi > lo), hi, lo + 1e-6)
             edges = lo + (hi - lo) * jnp.arange(n_bins + 1, dtype=jnp.float32) / n_bins
             counts = sum(
-                jnp.histogram(l.ravel().astype(jnp.float32), bins=edges)[0]
-                for l in leaves
+                # non-finite values become +inf: always beyond the finite
+                # top edge, so histogram drops them instead of polluting a
+                # bin (hi + 1.0 would collapse onto the edge once hi >= 2^24
+                # in f32 and count spikes into the top bin)
+                jnp.histogram(jnp.where(f, l, jnp.inf), bins=edges)[0]
+                for l, f in zip(leaves, fin)
             )
             out[f"{prefix}{key}"] = (counts, edges)
         return out

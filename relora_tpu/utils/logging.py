@@ -141,8 +141,13 @@ class MetricsLogger:
         Histogram objects."""
         if not self.enabled or not hists:
             return
+        import numpy as np
+
         record = {
-            k: {"counts": [int(c) for c in counts], "edges": [float(e) for e in edges]}
+            k: {
+                "counts": np.asarray(counts).astype(int).tolist(),
+                "edges": np.asarray(edges).astype(float).tolist(),
+            }
             for k, (counts, edges) in hists.items()
         }
         if step is not None:
@@ -152,8 +157,6 @@ class MetricsLogger:
             self._fh.write(json.dumps(record) + "\n")
             self._fh.flush()
         if self._wandb is not None:
-            import numpy as np
-
             self._wandb.log(
                 {
                     k: self._wandb.Histogram(

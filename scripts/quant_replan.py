@@ -1,0 +1,86 @@
+"""Quantized-base feasibility replan (round-5 verdict item #2, offline half):
+which (remat, loss, micro_batch, quantize) configs fit the 16 GB v5e at
+llama_1b r=128 seq1024 once the frozen base is int8/nf4 instead of an f32
+master.  Feasibility comes from the planner's own unrounded ``fits`` /
+``headroom_gb`` fields (total < 90% of HBM — tools/plan_memory.py:214-215);
+the display-rounded ``per_device_gb.total`` is recorded for the table only.
+
+In-process plan() calls (pure eval_shape arithmetic, no device memory), so
+the full 162-config grid runs in seconds — this sweep is also queued for
+tunnel-recovery windows where wall time is chip time.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/quant_replan.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from relora_tpu.utils.logging import honor_platform_request
+
+honor_platform_request()
+
+from tools.plan_memory import plan  # noqa: E402
+
+OUT = "bench_results/r5_quant_feasible.json"
+
+
+def main() -> None:
+    rows = []
+    for quantize in (None, "int8", "nf4"):
+        for loss in ("dense", "chunked"):
+            for remat in ("full", "dots", "dots_all"):
+                for mb in (2, 4, 8, 16, 24, 32, 48, 64, 96):
+                    p = plan(
+                        "llama_1b", rank=128, seq=1024, chip="v5e",
+                        micro_batch=mb, remat=remat, loss=loss,
+                        quantize=quantize,
+                    )
+                    rows.append({
+                        "quantize": quantize or "f32", "loss": loss,
+                        "remat": remat, "micro_batch": mb,
+                        "planned_total_gb": p["per_device_gb"]["total"],
+                        "fits_90pct": p["fits"],
+                        "headroom_gb": p["headroom_gb"],
+                    })
+    feasible = [r for r in rows if r["fits_90pct"]]
+    best = {}
+    for r in feasible:
+        k = (r["quantize"], r["loss"], r["remat"])
+        if k not in best or r["micro_batch"] > best[k]["micro_batch"]:
+            best[k] = r
+    result = {
+        "experiment": "llama_1b r=128 seq1024 single v5e (16 GB, 90% budget): "
+                      "feasible (remat, loss, micro_batch) set by frozen-base storage",
+        "baseline_note": "r4 ranking found dots/dots_all infeasible above mb4/mb2 "
+                         "with an f32 master base (bench_results/r4_lever_rank.json)",
+        "findings": [
+            "quantized base does NOT admit dots at mb8+: dots-remat activations, "
+            "not the frozen base, are the wall there (the r4 hypothesis that freed "
+            "HBM would admit dots mb8-16 is refuted by the plan)",
+            "what it does buy: ~3.6-4.1 GB headroom at dots/chunked mb4 "
+            "(14.08 -> 10.46/10.01 GB) -- the config the f32 plan called 'tight' "
+            "and r1's compile rejected; dots_all mb2 now fits even with dense loss",
+            "full-remat chunked grows mb48 -> mb64 (11.7/11.2 GB int8/nf4)",
+            "on-chip A/B still required: the r2 measurement showed logits-side "
+            "levers are noise, so the quantized-base win must be measured, not "
+            "assumed (queued in the recovery watcher)",
+        ],
+        "largest_feasible_mb": {f"{q}/{l}/{m}": r for (q, l, m), r in sorted(best.items())},
+        "grid": rows,
+    }
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    for k, r in sorted(best.items()):
+        print(k, "-> mb", r["micro_batch"], f"({r['planned_total_gb']} GB)")
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
