@@ -146,9 +146,10 @@ def main() -> None:
         cfg["micro_batch"] = int(mb_override)
     loss_impl = os.environ.get("BENCH_LOSS_IMPL", "dense")
     dropout = float(os.environ.get("BENCH_DROPOUT", "0.1"))
+    quantize = os.environ.get("BENCH_QUANTIZE") or None  # int8 | nf4 frozen base
     res = run_throughput_bench(
         remat=True, remat_policy=policy, rank=128, loss_impl=loss_impl,
-        dropout=dropout, **cfg
+        dropout=dropout, quantize=quantize, **cfg
     )
     line = {
         "metric": f"{_CFG_NAME} ReLoRA r=128 seq{_CFG['seq']} bf16 "
@@ -164,6 +165,7 @@ def main() -> None:
             "device": res["device"],
             "config": _CFG_NAME,
             "remat_policy": policy,
+            "quantize": quantize,
         },
     }
     print(json.dumps(line))

@@ -45,6 +45,7 @@ run_one() { # run_one <task> <backbone-name> <checkpoint-or-->
   if python run_glue.py --task_name "$task" \
     --train_file "$TASKS_DIR/$task/train.csv" \
     --validation_file "$TASKS_DIR/$task/validation.csv" \
+    --test_file "$TASKS_DIR/$task/test.csv" --do_predict true \
     --model_config "$MODEL" "${ckpt_flags[@]}" \
     --tokenizer "$TOKENIZER" \
     --lr "$LR" --batch_size "$BATCH" --num_epochs "$EPOCHS" \
@@ -74,6 +75,11 @@ for task in tasks:
     for name in ("relora", "full", "scratch"):
         p = os.path.join(work, f"{task}_{name}", "all_results.json")
         table[task][name] = json.load(open(p)) if os.path.exists(p) else None
+        # test-split predictions (--do_predict): recorded so the artifact
+        # points at them; absent for runs completed before predict was added
+        pred = os.path.join(work, f"{task}_{name}", f"predict_results_{task}.txt")
+        if table[task][name] is not None and os.path.exists(pred):
+            table[task][name]["predict_file"] = pred
 meta_path = os.path.join(tasks_dir, "meta.json")
 result = {
     "experiment": "local GLUE-format downstream eval of recorded parity checkpoints",

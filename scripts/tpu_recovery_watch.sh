@@ -1,5 +1,5 @@
 #!/bin/bash
-# TPU-tunnel recovery watcher (bench insurance), round-4 priorities.
+# TPU-tunnel recovery watcher (bench insurance), round-5 priorities.
 #
 # The sandbox's one-chip TPU tunnel has died mid-round in every round so far
 # (round 3: down the whole round); this watcher probes it and, the moment it
@@ -69,6 +69,14 @@ sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "r
 sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 2 --label "remat dots chunked mb2"
 sweep --remat --loss-impl chunked --micro-batch 32 --label "remat full chunked mb32"
 sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 2 --label "remat dots_all chunked mb2"
+# 2a'. round-5 quantized-base configs (bench_results/r5_quant_feasible.json):
+# int8/nf4 base gives dots/chunked mb4 ~4 GB of headroom (the f32 plan was
+# 14.08 GB "tight" and r1's compile rejected it) and raises full/chunked to
+# mb64 — measure whether the dequant cost eats the headroom win
+sweep --quantize int8 --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "int8 base dots chunked mb4"
+sweep --quantize nf4 --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "nf4 base dots chunked mb4"
+sweep --quantize int8 --remat --loss-impl chunked --micro-batch 64 --label "int8 base full chunked mb64"
+sweep --quantize int8 --remat --remat-policy dots_all --micro-batch 2 --label "int8 base dots_all dense mb2"
 sweep --remat --dropout 0 --label "remat full dropout0"
 sweep --remat --prng rbg --label "remat full rbg-prng"
 sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
@@ -93,6 +101,10 @@ try:
                 m.group(1) if m else "8",
                 "chunked" if "chunked" in label else "dense",
                 "0" if "dropout0" in label else "0.1",
+                # quantized winners must be replayed QUANTIZED: bench.py
+                # honors BENCH_QUANTIZE, and an f32 replay of the int8
+                # dots/mb4 winner is the 14-GB plan r1's compile rejected
+                "int8" if "int8" in label else ("nf4" if "nf4" in label else ""),
             ))
     head = json.load(open("bench_results/BENCH_r4_local.json"))
     print(best if best_mfu > head["detail"]["mfu"] else "")
@@ -101,12 +113,13 @@ except Exception:
 EOF
 )
 if [ -n "$BEST" ]; then
-  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS BEST_DROPOUT <<< "$BEST"
+  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS BEST_DROPOUT BEST_QUANT <<< "$BEST"
   BENCH_REMAT_POLICY="$BEST_POLICY" BENCH_MICRO_BATCH="$BEST_MB" \
     BENCH_LOSS_IMPL="$BEST_LOSS" BENCH_DROPOUT="$BEST_DROPOUT" \
+    BENCH_QUANTIZE="$BEST_QUANT" \
     BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
     > "$RES/BENCH_r4_local_${BEST_POLICY}.json" 2>/dev/null \
-    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT)" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
+    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT, quant ${BEST_QUANT:-f32})" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
 fi
 
 # 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
