@@ -602,9 +602,10 @@ class Trainer:
                     batch[0],
                     jax.random.fold_in(rng, 2**30 + self.update_step),
                 )
+                # one bulk transfer: per-element int()/float() on device
+                # arrays would sync once per bin through the TPU tunnel
                 self.metrics.log_histograms(
-                    {k: (v[0], v[1]) for k, v in hists.items()},
-                    step=self.global_step,
+                    jax.device_get(hists), step=self.global_step
                 )
 
             # ---- ReLoRA merge (torchrun_main.py:874-893) ----------------
