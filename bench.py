@@ -147,9 +147,10 @@ def main() -> None:
     loss_impl = os.environ.get("BENCH_LOSS_IMPL", "dense")
     dropout = float(os.environ.get("BENCH_DROPOUT", "0.1"))
     quantize = os.environ.get("BENCH_QUANTIZE") or None  # int8 | nf4 frozen base
+    base_dtype = os.environ.get("BENCH_BASE_DTYPE") or None  # bf16 frozen base
     res = run_throughput_bench(
         remat=True, remat_policy=policy, rank=128, loss_impl=loss_impl,
-        dropout=dropout, quantize=quantize, **cfg
+        dropout=dropout, quantize=quantize, base_dtype=base_dtype, **cfg
     )
     line = {
         "metric": f"{_CFG_NAME} ReLoRA r=128 seq{_CFG['seq']} bf16 "
@@ -166,6 +167,7 @@ def main() -> None:
             "config": _CFG_NAME,
             "remat_policy": policy,
             "quantize": quantize,
+            "base_dtype": base_dtype,
         },
     }
     print(json.dumps(line))

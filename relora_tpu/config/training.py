@@ -103,6 +103,9 @@ class TrainingConfig:
     # --- numerics ---
     dtype: str = "bfloat16"
     quantize: Optional[str] = None  # None | "int8" | "nf4"
+    # storage dtype for the unquantized frozen base: None = f32 master,
+    # "bf16" halves base HBM (merges still compute f32, core/relora.py)
+    base_dtype: Optional[str] = None  # None | "bf16"
     # nf4 only: int8-quantize the blockwise scales too (parity:
     # use_double_quant, args flag -> bnb_4bit_use_double_quant)
     use_double_quant: bool = True
@@ -240,6 +243,10 @@ class TrainingConfig:
 
         if self.quantize not in (None, "int8", "nf4"):
             raise ValueError(f"quantize must be None, 'int8' or 'nf4', got {self.quantize!r}")
+        if self.base_dtype not in (None, "bf16"):
+            raise ValueError(f"base_dtype must be None or 'bf16', got {self.base_dtype!r}")
+        if self.base_dtype and self.quantize:
+            raise ValueError("base_dtype applies to the unquantized base; drop it or quantize")
         if self.remat_policy not in ("full", "dots", "dots_all"):
             raise ValueError(
                 "remat_policy must be 'full', 'dots' or 'dots_all', "

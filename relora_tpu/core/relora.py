@@ -44,6 +44,14 @@ class LoraSpec:
     dropout: float = 0.1
     trainable_scaling: bool = False
     quantize: Optional[str] = None  # None | "int8" | "nf4"
+    # Storage dtype of the unquantized frozen base: None keeps the module's
+    # param_dtype (f32 master).  "bf16" stores the base in bfloat16 — the
+    # base takes no optimizer updates between merges, so the f32 master buys
+    # nothing per-step, while bf16 halves its HBM and (measured, round 5)
+    # removes the all-layers f32->bf16 convert temps XLA hoists out of the
+    # scan loop.  Merges still compute in f32 (lora_delta at HIGHEST) and
+    # cast back to storage, same as the int8/nf4 dequant->add->requant flow.
+    base_dtype: Optional[str] = None  # None | "bf16"
     # nf4 only: int8-quantize the per-block scales themselves (parity:
     # use_double_quant -> bnb_4bit_use_double_quant, relora.py:57-63)
     use_double_quant: bool = True
@@ -51,6 +59,16 @@ class LoraSpec:
     # relora.py:209-211; selected when neither relora, force_keep_original
     # nor a warm start needs the full kernel, torchrun_main.py:531-553)
     lora_only: bool = False
+
+    def __post_init__(self):
+        # validate HERE (not just TrainingConfig): bench.py/bench_sweep/
+        # plan_memory construct LoraSpec directly, and a typo'd or
+        # quantize-shadowed base_dtype would otherwise run the f32 master
+        # while the recorded measurement claims bf16
+        if self.base_dtype not in (None, "bf16"):
+            raise ValueError(f"base_dtype must be None or 'bf16', got {self.base_dtype!r}")
+        if self.base_dtype and self.quantize:
+            raise ValueError("base_dtype applies to the unquantized base; drop it or quantize")
 
     @property
     def scale(self) -> float:

@@ -117,11 +117,21 @@ class LoRALinear(nn.Module):
         elif quantize is not None:
             raise ValueError(f"Unknown quantize mode {quantize!r}")
         else:
+            # frozen-base storage dtype: spec.base_dtype == "bf16" drops the
+            # f32 master for the base kernel (it takes no per-step optimizer
+            # updates; merges cast back to storage dtype in core/relora.py).
+            # Only applies when the kernel IS a frozen LoRA base — a plain
+            # Dense (no LoRA spec) keeps the f32 master.
+            base_dtype = (
+                jnp.bfloat16
+                if (self.lora is not None and self.lora.base_dtype == "bf16")
+                else self.param_dtype
+            )
             kernel = self.param(
                 "kernel",
                 nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
                 (in_features, self.features),
-                self.param_dtype,
+                base_dtype,
             )
             y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))
         if self.use_bias:

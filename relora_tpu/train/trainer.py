@@ -164,6 +164,7 @@ class Trainer:
                 trainable_scaling=cfg.train_scaling,
                 quantize=cfg.quantize,
                 use_double_quant=cfg.use_double_quant,
+                base_dtype=cfg.base_dtype,
                 lora_only=not need_linear_weight,
             )
             if cfg.use_peft

@@ -29,6 +29,7 @@ def run_throughput_bench(
     attn: str = "auto",
     rank: Optional[int] = 128,
     quantize: Optional[str] = None,
+    base_dtype: Optional[str] = None,
     dropout: float = 0.1,
     warmup_steps: int = 3,
     measure_steps: int = 10,
@@ -62,7 +63,9 @@ def run_throughput_bench(
 
     cfg = MODEL_ZOO[model_name]
     spec = (
-        LoraSpec(r=rank, alpha=32, dropout=dropout, quantize=quantize) if rank else None
+        LoraSpec(r=rank, alpha=32, dropout=dropout, quantize=quantize, base_dtype=base_dtype)
+        if rank
+        else None
     )
     model = LlamaForCausalLM(
         cfg,

@@ -51,6 +51,10 @@ def main() -> None:
     p.add_argument(
         "--quantize", default="", choices=["", "int8", "nf4"], help="frozen-base storage"
     )
+    p.add_argument(
+        "--base-dtype", default="", choices=["", "bf16"],
+        help="unquantized frozen-base storage dtype (default f32 master)",
+    )
     p.add_argument("--dropout", type=float, default=0.1)
     p.add_argument("--prng", default="", help="jax_default_prng_impl override (e.g. rbg)")
     p.add_argument("--warmup", type=int, default=3)
@@ -84,6 +88,7 @@ def main() -> None:
         attn=args.attn,
         rank=args.rank,
         quantize=args.quantize or None,
+        base_dtype=args.base_dtype or None,
         dropout=args.dropout,
         warmup_steps=args.warmup,
         measure_steps=args.steps,
@@ -95,7 +100,8 @@ def main() -> None:
             f" remat={int(args.remat)}:{args.remat_policy}"
             f" {args.loss_impl} {args.logits_dtype}"
             f" attn={args.attn}"
-            + (f" quant={args.quantize}" if args.quantize else ""),
+            + (f" quant={args.quantize}" if args.quantize else "")
+            + (f" base={args.base_dtype}" if args.base_dtype else ""),
             "tokens_per_sec": res["tokens_per_sec"],
             "mfu": res["mfu"],
             "step_time_s": res["step_time_s"],

@@ -7,10 +7,10 @@
 # each stage's artifacts to git immediately so a second outage can't erase a
 # completed measurement:
 #   1. bench.py (the driver's headline number)        -> bench_results/
-#   2. remat/microbatch lever sweep (bench_sweep.py)  -> bench_results/r4_sweep.jsonl
+#   2. remat/microbatch lever sweep (bench_sweep.py)  -> bench_results/r5_sweep.jsonl
 #      + re-run the headline with the dots policy if it wins
-#   3. attention op-level A/B (bench_attention.py)    -> bench_results/r4_attn.jsonl
-#   4. quantized-base benches (int8 / nf4)            -> bench_results/r4_sweep.jsonl
+#   3. attention op-level A/B (bench_attention.py)    -> bench_results/r5_attn.jsonl
+#   4. quantized-base benches (int8 / nf4)            -> bench_results/r5_sweep.jsonl
 #   5. extra bench configs (250m, magnitude)          -> bench_results/
 #   6. loss-parity at llama_35m, 1000-step cycles (longest), then the
 #      magnitude-pruning variant at the same cycle length (shares warmup +
@@ -41,9 +41,9 @@ sweep() { # sweep <args...>
   # HLO): remote compiles ran 5-15 min in past rounds, so give the compile
   # room — the watchdog only bounds a wedged tunnel, not a slow compile
   BENCH_WATCHDOG_SECS=1500 timeout 1800 python scripts/bench_sweep.py \
-      --out "$RES/r4_sweep.jsonl" "$@" \
-    || echo "{\"error\": \"failed: $*\"}" >> "$RES/r4_sweep.jsonl"
-  commit "On-chip sweep: $*" -- "$RES/r4_sweep.jsonl"
+      --out "$RES/r5_sweep.jsonl" "$@" \
+    || echo "{\"error\": \"failed: $*\"}" >> "$RES/r5_sweep.jsonl"
+  commit "On-chip sweep: $*" -- "$RES/r5_sweep.jsonl"
 }
 
 echo "watcher start $(date -u +%FT%TZ)"
@@ -54,8 +54,8 @@ done
 echo "tunnel UP $(date -u +%FT%TZ)"
 
 # 1. headline bench
-BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_local.json" 2>/tmp/bench_r4.err \
-  && commit "On-chip headline bench (r4 local)" -- "$RES/BENCH_r4_local.json" "$RES/last_onchip.json"
+BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_local.json" 2>/tmp/bench_r5.err \
+  && commit "On-chip headline bench (r5 local)" -- "$RES/BENCH_r5_local.json" "$RES/last_onchip.json"
 
 # 2. lever sweep: the unmeasured big levers first
 # Queue = the configs tools/plan_memory says FIT a 16 GB v5e at 1B/seq1024
@@ -89,7 +89,7 @@ BEST=$(python - <<'EOF'
 import json, re
 best_mfu, best = 0.0, ""
 try:
-    for line in open("bench_results/r4_sweep.jsonl"):
+    for line in open("bench_results/r5_sweep.jsonl"):
         r = json.loads(line)
         label = r.get("label", "")
         mfu = r.get("mfu") or 0.0
@@ -106,7 +106,7 @@ try:
                 # dots/mb4 winner is the 14-GB plan r1's compile rejected
                 "int8" if "int8" in label else ("nf4" if "nf4" in label else ""),
             ))
-    head = json.load(open("bench_results/BENCH_r4_local.json"))
+    head = json.load(open("bench_results/BENCH_r5_local.json"))
     print(best if best_mfu > head["detail"]["mfu"] else "")
 except Exception:
     print("")
@@ -118,17 +118,17 @@ if [ -n "$BEST" ]; then
     BENCH_LOSS_IMPL="$BEST_LOSS" BENCH_DROPOUT="$BEST_DROPOUT" \
     BENCH_QUANTIZE="$BEST_QUANT" \
     BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
-    > "$RES/BENCH_r4_local_${BEST_POLICY}.json" 2>/dev/null \
-    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT, quant ${BEST_QUANT:-f32})" -- "$RES/BENCH_r4_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
+    > "$RES/BENCH_r5_local_${BEST_POLICY}.json" 2>/dev/null \
+    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT, quant ${BEST_QUANT:-f32})" -- "$RES/BENCH_r5_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
 fi
 
 # 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
 timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
-  > "$RES/r4_attn.jsonl" 2>/tmp/attn_r4.err \
-  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r4_attn.jsonl"
+  > "$RES/r5_attn.jsonl" 2>/tmp/attn_r5.err \
+  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r5_attn.jsonl"
 timeout 2400 python scripts/bench_attention.py --seqs 4096 16384 --impls xla pallas \
-  --kv-heads 4 >> "$RES/r4_attn.jsonl" 2>>/tmp/attn_r4.err \
-  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r4_attn.jsonl"
+  --kv-heads 4 >> "$RES/r5_attn.jsonl" 2>>/tmp/attn_r5.err \
+  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r5_attn.jsonl"
 
 # 4. quantized-base benches
 sweep --remat --quantize int8 --label "remat int8-base"
@@ -136,10 +136,10 @@ sweep --remat --quantize nf4 --label "remat nf4-base"
 RELORA_TPU_PALLAS_QUANT=1 sweep --remat --quantize int8 --label "remat int8-base pallas-dequant"
 
 # 5. extra configs
-BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_250m.json" 2>/dev/null \
-  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r4_250m.json"
-BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r4_magnitude.json" 2>/dev/null \
-  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r4_magnitude.json"
+BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_250m.json" 2>/dev/null \
+  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r5_250m.json"
+BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_magnitude.json" 2>/dev/null \
+  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r5_magnitude.json"
 
 # 6. loss parity (longest): llama_35m, 4000 steps, 1000-step cycles — the
 # scale rung the round-3 verdict asked for (~1.6h/branch on the v5e).
@@ -150,8 +150,8 @@ CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity \
   > /tmp/loss_parity.log 2>&1
 echo "loss_parity exit=$? $(date -u +%FT%TZ)"
 if [ -f /tmp/loss_parity/compare_llama_35m.json ]; then
-  cp /tmp/loss_parity/compare_llama_35m.json "$RES/r4_loss_parity_chip.json"
-  commit "On-chip loss-parity result (llama_35m, 1000-step cycles)" -- "$RES/r4_loss_parity_chip.json"
+  cp /tmp/loss_parity/compare_llama_35m.json "$RES/r5_loss_parity_chip.json"
+  commit "On-chip loss-parity result (llama_35m, 1000-step cycles)" -- "$RES/r5_loss_parity_chip.json"
 fi
 
 # 6b. magnitude-pruning reset at the same (reference-like) cycle length,
@@ -161,7 +161,7 @@ CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity OPT_PRUNE=0.9 \
   > /tmp/loss_parity_mag.log 2>&1
 echo "loss_parity magnitude exit=$? $(date -u +%FT%TZ)"
 if [ -f /tmp/loss_parity/compare_llama_35m_mag0.9.json ]; then
-  cp /tmp/loss_parity/compare_llama_35m_mag0.9.json "$RES/r4_loss_parity_chip_mag.json"
-  commit "On-chip loss-parity: magnitude-pruning reset at 1000-step cycles" -- "$RES/r4_loss_parity_chip_mag.json"
+  cp /tmp/loss_parity/compare_llama_35m_mag0.9.json "$RES/r5_loss_parity_chip_mag.json"
+  commit "On-chip loss-parity: magnitude-pruning reset at 1000-step cycles" -- "$RES/r5_loss_parity_chip_mag.json"
 fi
 echo "watcher done $(date -u +%FT%TZ)"

@@ -90,6 +90,28 @@ def test_merge_math_and_reinit():
     assert jax.tree_util.tree_structure(out) == jax.tree_util.tree_structure(params)
 
 
+def test_merge_preserves_bf16_base_storage():
+    """A bf16-stored frozen base (LoraSpec.base_dtype='bf16') merges in f32
+    and casts back to bf16 — dtype preserved, value within one bf16 ulp of
+    the f32 merge."""
+    spec = LoraSpec(r=4, alpha=32, base_dtype="bf16")
+    params = make_params()
+    q = params["layer"]["q_proj"]
+    expected = (
+        q["kernel"].astype(jnp.float32)
+        + (q["lora_a"].astype(jnp.float32) @ q["lora_b"].astype(jnp.float32)) * spec.scale
+    )
+    params["layer"]["q_proj"] = dict(q, kernel=q["kernel"].astype(jnp.bfloat16))
+
+    out = merge_and_reinit(params, jax.random.PRNGKey(1), spec)
+    merged = out["layer"]["q_proj"]["kernel"]
+    assert merged.dtype == jnp.bfloat16
+    # one bf16 rounding of the f32 merge: relative error <= 2^-8
+    np.testing.assert_allclose(
+        np.asarray(merged, np.float32), np.asarray(expected), rtol=2 ** -7, atol=1e-3
+    )
+
+
 def test_merge_trainable_scaling_uses_tanh_and_resets():
     spec = LoraSpec(r=4, alpha=32, trainable_scaling=True)
     params = make_params(trainable_scaling=True)

@@ -88,6 +88,29 @@ def test_loss_decreases_lora_only_trainables_move():
     assert np.abs(lora_b_before).max() == 0
 
 
+def test_bf16_base_storage_trains_and_stays_bf16():
+    """base_dtype='bf16' stores ONLY the frozen LoRA-base kernels in bf16
+    (trainables — LoRA factors, embeddings, norms, lm_head — keep the f32
+    master) and the step still descends."""
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0, base_dtype="bf16")
+    model, state, step = build(lora=spec)
+    attn = state.params["layers"]["self_attn"]
+    assert attn["q_proj"]["kernel"].dtype == jnp.bfloat16
+    assert attn["q_proj"]["lora_a"].dtype == jnp.float32
+    assert state.params["embed_tokens"]["embedding"].dtype == jnp.float32
+    assert state.params["lm_head"]["kernel"].dtype == jnp.float32
+
+    step = jax.jit(step, donate_argnums=0)
+    batch = jax.random.randint(jax.random.PRNGKey(1), (1, 4, 16), 0, 128)
+    first = None
+    for i in range(20):
+        state, metrics = step(state, batch, jax.random.PRNGKey(i))
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    assert state.params["layers"]["self_attn"]["q_proj"]["kernel"].dtype == jnp.bfloat16
+
+
 def test_nan_gate_skips_update_but_advances_step():
     model, state, step = build()
     step = jax.jit(step)

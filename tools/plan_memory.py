@@ -58,6 +58,7 @@ def plan(
     seq: int = 1024,
     dtype: str = "bf16",
     quantize=None,
+    base_dtype=None,
     remat: str = "full",
     loss: str = "dense",
     chip: str = "v5e",
@@ -101,7 +102,9 @@ def plan(
     # bytes are then computed exactly from leaf shapes+dtypes instead of an
     # approximate per-element factor model
     spec = (
-        LoraSpec(r=rank, alpha=32, dropout=0.0, quantize=quantize) if rank else None
+        LoraSpec(r=rank, alpha=32, dropout=0.0, quantize=quantize, base_dtype=base_dtype)
+        if rank
+        else None
     )
     jdtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     mdl = LlamaForCausalLM(cfg, lora=spec, dtype=jdtype, scan_layers=True)
@@ -225,6 +228,8 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--quantize", default=None, choices=[None, "int8", "nf4"])
+    p.add_argument("--base-dtype", default=None, choices=[None, "bf16"],
+                   help="unquantized frozen-base storage dtype (default f32 master)")
     p.add_argument("--remat", default="full", choices=["full", "dots", "dots_all", "none"])
     p.add_argument("--loss", default="dense", choices=["dense", "chunked"])
     p.add_argument("--chip", default="v5e", choices=sorted(CHIP_HBM))
@@ -245,6 +250,7 @@ def main() -> None:
         seq=args.seq,
         dtype=args.dtype,
         quantize=args.quantize,
+        base_dtype=args.base_dtype,
         remat=args.remat,
         loss=args.loss,
         chip=args.chip,
