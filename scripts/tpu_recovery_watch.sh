@@ -1,22 +1,18 @@
 #!/bin/bash
-# TPU-tunnel recovery watcher (bench insurance), round-5 priorities.
+# TPU-tunnel recovery watcher — round-5 priorities, v2 (post first window).
 #
-# The sandbox's one-chip TPU tunnel has died mid-round in every round so far
-# (round 3: down the whole round); this watcher probes it and, the moment it
-# answers, runs the queued on-chip work in strict priority order — committing
-# each stage's artifacts to git immediately so a second outage can't erase a
-# completed measurement:
-#   1. bench.py (the driver's headline number)        -> bench_results/
-#   2. remat/microbatch lever sweep (bench_sweep.py)  -> bench_results/r5_sweep.jsonl
-#      + re-run the headline with the dots policy if it wins
-#   3. attention op-level A/B (bench_attention.py)    -> bench_results/r5_attn.jsonl
-#   4. quantized-base benches (int8 / nf4)            -> bench_results/r5_sweep.jsonl
-#   5. extra bench configs (250m, magnitude)          -> bench_results/
-#   6. loss-parity at llama_35m, 1000-step cycles (longest), then the
-#      magnitude-pruning variant at the same cycle length (shares warmup +
-#      full-rank branches)
+# The 2026-07-31 03:44-04:26Z tunnel window landed the first driver-grade
+# on-chip headline in four rounds (6,920.7 tok/s, 26.85% MFU) plus one sweep
+# point (dots/chunked mb2: 7,498.7 tok/s, 29.1% MFU) and three *informative*
+# OOM failures: XLA hoists the all-layers f32->bf16 kernel converts out of
+# the scan loop, costing ~5 GB the planner never saw (dots/chunked mb4:
+# planned 14.08 GB, used 19.04 GB).  That finding produced the
+# LoraSpec.base_dtype='bf16' lever (no f32 master for the frozen base: no
+# convert temps, half the base bytes) — this queue leads with it, and loss
+# parity moved up (it is the longest stage and a verdict must-have; the
+# first window died before reaching it at queue position 6).
 #
-# Usage: nohup bash scripts/tpu_recovery_watch.sh > /tmp/tpu_watch.log 2>&1 &
+# Usage: nohup bash scripts/tpu_recovery_watch.sh > /tmp/tpu_watch_r5.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 RES=bench_results
@@ -37,9 +33,9 @@ probe() {
 }
 
 sweep() { # sweep <args...>
-  # each config is a FRESH program on-chip (policy/microbatch changes the
-  # HLO): remote compiles ran 5-15 min in past rounds, so give the compile
-  # room — the watchdog only bounds a wedged tunnel, not a slow compile
+  # each config is a FRESH program on-chip; remote compiles ran 5-25 min in
+  # past windows, so give the compile room — the watchdog only bounds a
+  # wedged tunnel, not a slow compile
   BENCH_WATCHDOG_SECS=1500 timeout 1800 python scripts/bench_sweep.py \
       --out "$RES/r5_sweep.jsonl" "$@" \
     || echo "{\"error\": \"failed: $*\"}" >> "$RES/r5_sweep.jsonl"
@@ -53,38 +49,15 @@ while ! probe; do
 done
 echo "tunnel UP $(date -u +%FT%TZ)"
 
-# 1. headline bench
-BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_local.json" 2>/tmp/bench_r5.err \
-  && commit "On-chip headline bench (r5 local)" -- "$RES/BENCH_r5_local.json" "$RES/last_onchip.json"
+# 1. the bf16-base lever, best-first (quant_replan: dots/chunked mb4 plans
+# 11.67 GB with no convert temps — the f32 version of this config used
+# 19.04 GB and OOMed; mb2 is the safe A/B against f32's measured 29.1%)
+sweep --base-dtype bf16 --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "bf16 base dots chunked mb4"
+sweep --base-dtype bf16 --remat --remat-policy dots --loss-impl chunked --micro-batch 2 --label "bf16 base dots chunked mb2"
 
-# 2. lever sweep: the unmeasured big levers first
-# Queue = the configs tools/plan_memory says FIT a 16 GB v5e at 1B/seq1024
-# (the naive dots-family mb8/mb16 plans need 19-32 GB — r1's "compile
-# rejected" dots attempts were never going to run), ordered by expected
-# value: the dots policy cuts executed matmul FLOPs 24% (r4_lever_rank),
-# so its small-mb configs lead; large-mb full-remat trades no FLOPs but
-# better MXU utilization; dots_all mb2 misses the 90% HBM budget by 0.3 GB
-# and gets exactly one attempt (a failure line is recorded and we move on).
-sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "remat dots chunked mb4"
-sweep --remat --remat-policy dots --loss-impl chunked --micro-batch 2 --label "remat dots chunked mb2"
-sweep --remat --loss-impl chunked --micro-batch 32 --label "remat full chunked mb32"
-sweep --remat --remat-policy dots_all --loss-impl chunked --micro-batch 2 --label "remat dots_all chunked mb2"
-# 2a'. round-5 quantized-base configs (bench_results/r5_quant_feasible.json):
-# int8/nf4 base gives dots/chunked mb4 ~4 GB of headroom (the f32 plan was
-# 14.08 GB "tight" and r1's compile rejected it) and raises full/chunked to
-# mb64 — measure whether the dequant cost eats the headroom win
-sweep --quantize int8 --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "int8 base dots chunked mb4"
-sweep --quantize nf4 --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "nf4 base dots chunked mb4"
-sweep --quantize int8 --remat --loss-impl chunked --micro-batch 64 --label "int8 base full chunked mb64"
-sweep --quantize int8 --remat --remat-policy dots_all --micro-batch 2 --label "int8 base dots_all dense mb2"
-sweep --remat --dropout 0 --label "remat full dropout0"
-sweep --remat --prng rbg --label "remat full rbg-prng"
-sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
-sweep --remat --loss-impl chunked --micro-batch 24 --label "remat full chunked mb24"
-
-# 2b. if a dots-family policy beat the stage-1 headline, land a headline
-# number with the WINNING policy at the micro-batch it actually won at
-# (dots_all may only fit at mb4; bench.py honors BENCH_MICRO_BATCH)
+# 2. winner replay through bench.py: refreshes last_onchip.json +
+# BENCH_r5_local so the driver's end-of-round run reflects the best
+# measured config even through an outage
 BEST=$(python - <<'EOF'
 import json, re
 best_mfu, best = 0.0, ""
@@ -101,10 +74,12 @@ try:
                 m.group(1) if m else "8",
                 "chunked" if "chunked" in label else "dense",
                 "0" if "dropout0" in label else "0.1",
-                # quantized winners must be replayed QUANTIZED: bench.py
-                # honors BENCH_QUANTIZE, and an f32 replay of the int8
-                # dots/mb4 winner is the 14-GB plan r1's compile rejected
+                # quantized/bf16-base winners must be replayed with the SAME
+                # base storage: bench.py honors BENCH_QUANTIZE and
+                # BENCH_BASE_DTYPE, and an f32 replay of a bf16-base winner
+                # is the 19-GB plan the compile already rejected
                 "int8" if "int8" in label else ("nf4" if "nf4" in label else ""),
+                "bf16" if "bf16 base" in label else "",
             ))
     head = json.load(open("bench_results/BENCH_r5_local.json"))
     print(best if best_mfu > head["detail"]["mfu"] else "")
@@ -113,49 +88,26 @@ except Exception:
 EOF
 )
 if [ -n "$BEST" ]; then
-  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS BEST_DROPOUT BEST_QUANT <<< "$BEST"
+  IFS=: read -r BEST_POLICY BEST_MB BEST_LOSS BEST_DROPOUT BEST_QUANT BEST_BASE <<< "$BEST"
   BENCH_REMAT_POLICY="$BEST_POLICY" BENCH_MICRO_BATCH="$BEST_MB" \
     BENCH_LOSS_IMPL="$BEST_LOSS" BENCH_DROPOUT="$BEST_DROPOUT" \
-    BENCH_QUANTIZE="$BEST_QUANT" \
+    BENCH_QUANTIZE="$BEST_QUANT" BENCH_BASE_DTYPE="$BEST_BASE" \
     BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py \
     > "$RES/BENCH_r5_local_${BEST_POLICY}.json" 2>/dev/null \
-    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, dropout $BEST_DROPOUT, quant ${BEST_QUANT:-f32})" -- "$RES/BENCH_r5_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
+    && commit "On-chip headline bench with $BEST_POLICY remat (mb $BEST_MB, $BEST_LOSS loss, base ${BEST_BASE:-${BEST_QUANT:-f32}})" -- "$RES/BENCH_r5_local_${BEST_POLICY}.json" "$RES/last_onchip.json"
 fi
 
-# 3. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
-timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
-  > "$RES/r5_attn.jsonl" 2>/tmp/attn_r5.err \
-  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r5_attn.jsonl"
-timeout 2400 python scripts/bench_attention.py --seqs 4096 16384 --impls xla pallas \
-  --kv-heads 4 >> "$RES/r5_attn.jsonl" 2>>/tmp/attn_r5.err \
-  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r5_attn.jsonl"
-
-# 4. quantized-base benches
-sweep --remat --quantize int8 --label "remat int8-base"
-sweep --remat --quantize nf4 --label "remat nf4-base"
-RELORA_TPU_PALLAS_QUANT=1 sweep --remat --quantize int8 --label "remat int8-base pallas-dequant"
-
-# 5. extra configs
-BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_250m.json" 2>/dev/null \
-  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r5_250m.json"
-BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_magnitude.json" 2>/dev/null \
-  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r5_magnitude.json"
-
-# 6. loss parity (longest): llama_35m, 4000 steps, 1000-step cycles — the
-# scale rung the round-3 verdict asked for (~1.6h/branch on the v5e).
-# loss_parity.sh keys run dirs by model/seed/variant, so the zero-reset and
-# magnitude variants share the warmup + full-rank branches.
+# 3. loss parity (the longest stage, and a verdict must: gap <=1% at 35m
+# with 1000-step cycles).  4000 steps; the magnitude variant reuses the
+# shared warmup + full-rank branches, so only its ReLoRA branch runs.
 CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity \
   STEPS_WARMUP=500 STEPS_TOTAL=4000 bash scripts/loss_parity.sh \
   > /tmp/loss_parity.log 2>&1
 echo "loss_parity exit=$? $(date -u +%FT%TZ)"
 if [ -f /tmp/loss_parity/compare_llama_35m.json ]; then
   cp /tmp/loss_parity/compare_llama_35m.json "$RES/r5_loss_parity_chip.json"
-  commit "On-chip loss-parity result (llama_35m, 1000-step cycles)" -- "$RES/r5_loss_parity_chip.json"
+  commit "On-chip loss-parity result (llama_35m, 1000-step cycles, 4000 steps)" -- "$RES/r5_loss_parity_chip.json"
 fi
-
-# 6b. magnitude-pruning reset at the same (reference-like) cycle length,
-# reusing the shared warmup/full-rank branches — only the ReLoRA branch runs
 CORPUS=/tmp/corpus/local400 WORK=/tmp/loss_parity OPT_PRUNE=0.9 \
   STEPS_WARMUP=500 STEPS_TOTAL=4000 bash scripts/loss_parity.sh \
   > /tmp/loss_parity_mag.log 2>&1
@@ -164,4 +116,44 @@ if [ -f /tmp/loss_parity/compare_llama_35m_mag0.9.json ]; then
   cp /tmp/loss_parity/compare_llama_35m_mag0.9.json "$RES/r5_loss_parity_chip_mag.json"
   commit "On-chip loss-parity: magnitude-pruning reset at 1000-step cycles" -- "$RES/r5_loss_parity_chip_mag.json"
 fi
+
+# 4. attention op-level A/B — MHA then GQA (16q/4kv, the un-expanded path)
+timeout 2400 python scripts/bench_attention.py --seqs 1024 4096 16384 --impls xla pallas \
+  > "$RES/r5_attn.jsonl" 2>/tmp/attn_r5.err \
+  && commit "Attention op-level A/B (xla vs pallas, 1k/4k/16k)" -- "$RES/r5_attn.jsonl"
+timeout 2400 python scripts/bench_attention.py --seqs 4096 16384 --impls xla pallas \
+  --kv-heads 4 >> "$RES/r5_attn.jsonl" 2>>/tmp/attn_r5.err \
+  && commit "Attention op-level A/B: GQA 16q/4kv" -- "$RES/r5_attn.jsonl"
+
+# 5. remaining utilization/base-storage levers, by expected value.  The
+# first window's OOMs: f32 full/chunked OOMed at mb32 (20.37 GB), so mb16
+# is the biggest safe f32 step; bf16-base full/chunked saves ~4.8 GB so
+# mb24 should fit where f32 mb32 did not.  int8 at mb8 compiles like the
+# baseline (no dots interplay); the int8+dots combination compiled >25 min
+# and is deprioritized to last.
+sweep --remat --loss-impl chunked --micro-batch 16 --label "remat full chunked mb16"
+sweep --base-dtype bf16 --remat --loss-impl chunked --micro-batch 24 --label "bf16 base full chunked mb24"
+sweep --base-dtype bf16 --remat --remat-policy dots_all --loss-impl chunked --micro-batch 2 --label "bf16 base dots_all chunked mb2"
+sweep --remat --quantize int8 --label "remat int8-base"
+sweep --remat --quantize nf4 --label "remat nf4-base"
+RELORA_TPU_PALLAS_QUANT=1 sweep --remat --quantize int8 --label "remat int8-base pallas-dequant"
+sweep --remat --dropout 0 --label "remat full dropout0"
+
+# 6. extra bench configs
+BENCH_CONFIG=llama_250m BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_250m.json" 2>/dev/null \
+  && commit "On-chip bench: llama_250m config" -- "$RES/BENCH_r5_250m.json"
+BENCH_CONFIG=llama_1b_magnitude BENCH_WATCHDOG_SECS=1500 timeout 1800 python bench.py > "$RES/BENCH_r5_magnitude.json" 2>/dev/null \
+  && commit "On-chip bench: magnitude-reset config" -- "$RES/BENCH_r5_magnitude.json"
+
+# 7. long-context throughput (verdict weak #4): flash ring fold body at
+# long context, one JSON line per seq, partial results survive an outage
+for S in 4096 16384 32768; do
+  timeout 1800 python tools/bench_longcontext.py --mode throughput --seq "$S" \
+    >> "$RES/r5_longcontext.jsonl" 2>/tmp/longctx_r5.err \
+    || echo "{\"error\": \"failed: seq $S\"}" >> "$RES/r5_longcontext.jsonl"
+done
+commit "Long-context throughput bench (4k/16k/32k)" -- "$RES/r5_longcontext.jsonl"
+
+# 8. slow compiles, one attempt each
+sweep --quantize int8 --remat --remat-policy dots --loss-impl chunked --micro-batch 4 --label "int8 base dots chunked mb4 retry"
 echo "watcher done $(date -u +%FT%TZ)"
