@@ -6,7 +6,7 @@ master.  Feasibility comes from the planner's own unrounded ``fits`` /
 the display-rounded ``per_device_gb.total`` is recorded for the table only.
 
 In-process plan() calls (pure eval_shape arithmetic, no device memory), so
-the full 162-config grid runs in seconds — this sweep is also queued for
+the full 216-config grid runs in seconds — this sweep is also queued for
 tunnel-recovery windows where wall time is chip time.
 
 Usage::
