@@ -154,6 +154,9 @@ def main() -> None:
     mb_override = os.environ.get("BENCH_MICRO_BATCH")
     if mb_override:
         cfg["micro_batch"] = int(mb_override)
+    ga_override = os.environ.get("BENCH_GRAD_ACCUM")
+    if ga_override:
+        cfg["grad_accum"] = int(ga_override)
     dropout = float(os.environ.get("BENCH_DROPOUT", "0.1"))
     quantize = os.environ.get("BENCH_QUANTIZE") or None  # int8 | nf4 frozen base
     base_dtype = os.environ.get("BENCH_BASE_DTYPE") or None  # bf16 frozen base
