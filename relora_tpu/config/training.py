@@ -118,7 +118,7 @@ class TrainingConfig:
     sp_size: int = 1  # sequence (context parallel) axis
     sp_impl: str = "ring"  # ring (streamed K/V) | ulysses (all-to-all heads)
     remat: bool = False  # gradient checkpointing on decoder layers
-    remat_policy: str = "full"  # 'full' | 'dots' | 'dots_all' (params_util.remat_policy)
+    remat_policy: str = "full"  # 'full' | 'dots' | 'dots_narrow' | 'dots_all' (params_util.remat_policy)
     bf16_logits: bool = False  # halve the logits HBM footprint; CE still f32
     loss_impl: str = "dense"  # dense | chunked (streamed vocab CE, no full logits)
     vocab_chunk: int = 8192  # chunk size for loss_impl=chunked
@@ -247,9 +247,9 @@ class TrainingConfig:
             raise ValueError(f"base_dtype must be None or 'bf16', got {self.base_dtype!r}")
         if self.base_dtype and self.quantize:
             raise ValueError("base_dtype applies to the unquantized base; drop it or quantize")
-        if self.remat_policy not in ("full", "dots", "dots_all"):
+        if self.remat_policy not in ("full", "dots", "dots_narrow", "dots_all"):
             raise ValueError(
-                "remat_policy must be 'full', 'dots' or 'dots_all', "
+                "remat_policy must be 'full', 'dots', 'dots_narrow' or 'dots_all', "
                 f"got {self.remat_policy!r}"
             )
 

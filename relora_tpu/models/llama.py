@@ -215,7 +215,10 @@ def decoder_stack(
             block,
             prevent_cse=not module.scan_layers,
             static_argnums=(4,),  # deterministic
-            policy=remat_policy(getattr(module, "remat_policy", "full")),
+            policy=remat_policy(
+                getattr(module, "remat_policy", "full"),
+                max_save_width=cfg.hidden_size,
+            ),
         )
     layer_kwargs = dict(
         config=cfg,

@@ -198,7 +198,9 @@ class GPTNeoXForCausalLM(nn.Module):
                 block,
                 prevent_cse=not self.scan_layers,
                 static_argnums=(4,),
-                policy=remat_policy(self.remat_policy),
+                policy=remat_policy(
+                    self.remat_policy, max_save_width=self.config.hidden_size
+                ),
             )
         layer_kwargs = dict(
             config=cfg, lora=self.lora, dtype=self.dtype, attention_impl=self.attention_impl

@@ -41,7 +41,7 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--remat", action="store_true")
     p.add_argument(
-        "--remat-policy", default="full", choices=["full", "dots", "dots_all"]
+        "--remat-policy", default="full", choices=["full", "dots", "dots_narrow", "dots_all"]
     )
     p.add_argument("--loss-impl", default="dense", choices=["dense", "chunked"])
     p.add_argument("--vocab-chunk", type=int, default=8192)

@@ -54,6 +54,21 @@ SUFFIX=""
 [ "$CYCLE" != "1000" ] && SUFFIX="${SUFFIX}_c${CYCLE}"
 [ "$RESTART_WARMUP" != "100" ] && SUFFIX="${SUFFIX}_rw${RESTART_WARMUP}"
 [ -n "$OPT_PRUNE" ] && SUFFIX="${SUFFIX}_mag${OPT_PRUNE}"
+# The corpus build (tools/build_text_corpus.py) writes <out>.meta.json as
+# its final act — wait for it (bounded) instead of failing when this script
+# is launched while a fresh-sandbox rebuild is still training the BPE
+# tokenizer.  WAIT_CORPUS_SECS=0 restores fail-fast.
+WAIT_CORPUS_SECS="${WAIT_CORPUS_SECS:-5400}"
+waited=0
+while [ ! -f "${CORPUS}.meta.json" ] && [ "$waited" -lt "$WAIT_CORPUS_SECS" ]; do
+  [ "$waited" -eq 0 ] && echo "waiting for corpus ${CORPUS}.meta.json (up to ${WAIT_CORPUS_SECS}s) ..."
+  sleep 60; waited=$((waited + 60))
+done
+if [ ! -f "${CORPUS}.meta.json" ]; then
+  echo "corpus ${CORPUS} not ready after ${waited}s — aborting" >&2
+  exit 3
+fi
+
 RKEY="${KEY}${SUFFIX}"
 # keyed by RKEY (MODEL/SEED + variant suffix), not SUFFIX alone: runs that
 # share a WORK dir across models/seeds must not overwrite each other's

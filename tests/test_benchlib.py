@@ -42,7 +42,7 @@ def test_remat_policy_dots_matches_full():
     from relora_tpu.utils.benchlib import run_throughput_bench
 
     losses = {}
-    for policy in ("full", "dots"):
+    for policy in ("full", "dots", "dots_narrow"):
         res = run_throughput_bench(
             "llama_9m",
             micro_batch=2,
@@ -55,6 +55,39 @@ def test_remat_policy_dots_matches_full():
         losses[policy] = res["loss"]
     assert np.isfinite(losses["full"])
     np.testing.assert_allclose(losses["full"], losses["dots"], rtol=1e-5)
+    np.testing.assert_allclose(losses["full"], losses["dots_narrow"], rtol=1e-5)
+
+
+def test_remat_policy_dots_narrow_predicate():
+    """dots_narrow saves hidden-width dot outputs, recomputes wider ones and
+    batched dots — checked directly against the policy callable."""
+    from relora_tpu.models.params_util import remat_policy
+
+    pol = remat_policy("dots_narrow", max_save_width=64)
+
+    class P:
+        name = "dot_general"
+
+    class Aval:
+        def __init__(self, shape):
+            self.shape = shape
+
+    dn = lambda rhs_c, batch=(): {"dimension_numbers": (((1,), rhs_c), (batch, batch))}
+    # hidden-width projection (rhs 64x64): saved
+    assert pol(P(), Aval((8, 64)), Aval((64, 64)), **dn((0,)))
+    # wide MLP projection (rhs 64x171): recomputed
+    assert not pol(P(), Aval((8, 64)), Aval((64, 171)), **dn((0,)))
+    # down-projection back to hidden (rhs 171x64): saved
+    assert pol(P(), Aval((8, 171)), Aval((171, 64)), **dn((0,)))
+    # batched dot (attention QK^T shape): recomputed regardless of width
+    assert not pol(P(), Aval((2, 8, 16)), Aval((2, 16, 8)), **dn((1,), (0,)))
+    # non-dot primitives: never saved
+    class Q:
+        name = "exp"
+
+    assert not pol(Q(), Aval((8, 64)))
+    with pytest.raises(ValueError, match="max_save_width"):
+        remat_policy("dots_narrow")
 
 
 def test_remat_policy_unknown_raises():

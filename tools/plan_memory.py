@@ -168,6 +168,13 @@ def plan(
         inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
         per_layer = tok * (H * 5 + inter * 3) * bytes_el
         act = L * per_layer
+    elif remat == "dots_narrow":
+        # boundaries + hidden-width matmul outputs only: the intermediate-
+        # width gate/up outputs are recomputed (params_util.remat_policy
+        # 'dots_narrow'), eliminating the inter-width residual term that
+        # dominates 'dots' memory at wide-MLP models
+        per_layer = tok * (H * 5) * bytes_el
+        act = L * per_layer
     elif remat == "dots_all":
         # dots_saveable additionally keeps the S^2-per-head attention
         # logits as residuals, in COMPUTE dtype (params_util.remat_policy)
@@ -230,7 +237,9 @@ def main() -> None:
     p.add_argument("--quantize", default=None, choices=[None, "int8", "nf4"])
     p.add_argument("--base-dtype", default=None, choices=[None, "bf16"],
                    help="unquantized frozen-base storage dtype (default f32 master)")
-    p.add_argument("--remat", default="full", choices=["full", "dots", "dots_all", "none"])
+    p.add_argument(
+        "--remat", default="full", choices=["full", "dots", "dots_narrow", "dots_all", "none"]
+    )
     p.add_argument("--loss", default="dense", choices=["dense", "chunked"])
     p.add_argument("--chip", default="v5e", choices=sorted(CHIP_HBM))
     p.add_argument("--layers", type=int, default=0, help="override layer count")
