@@ -45,6 +45,7 @@ CONFIGS = [
     # transfers, but MXU efficiency at small mb is what the on-chip sweep
     # actually settles)
     ("remat full (r2 baseline)", dict(remat=True, remat_policy="full")),
+    ("remat dots_narrow chunked mb8", dict(remat=True, remat_policy="dots_narrow", loss_impl="chunked", micro_batch=8)),
     ("remat dots chunked mb4", dict(remat=True, remat_policy="dots", loss_impl="chunked", micro_batch=4)),
     ("remat dots chunked mb2", dict(remat=True, remat_policy="dots", loss_impl="chunked", micro_batch=2)),
     ("remat dots_all chunked mb2", dict(remat=True, remat_policy="dots_all", loss_impl="chunked", micro_batch=2)),

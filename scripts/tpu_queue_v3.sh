@@ -102,14 +102,23 @@ while ! probe; do
 done
 echo "tunnel UP $(date -u +%FT%TZ)"
 
-# 1. dots_narrow ladder, largest mb first; stop at first success
-if sweep --base-dtype bf16 --remat --remat-policy dots_narrow --loss-impl chunked --micro-batch 8 --label "bf16 base dots_narrow chunked mb8"; then
+# 1. dots_narrow ladder, largest mb first; stop at first success (same
+# FLOPs/token; larger mb is >= on MXU utilization).  OOM failures cost
+# ~90 s; successful compiles are the slow part.
+if sweep --base-dtype bf16 --remat --remat-policy dots_narrow --loss-impl chunked --micro-batch 12 --label "bf16 base dots_narrow chunked mb12"; then
+  :
+elif sweep --base-dtype bf16 --remat --remat-policy dots_narrow --loss-impl chunked --micro-batch 8 --label "bf16 base dots_narrow chunked mb8"; then
   :
 elif sweep --base-dtype bf16 --remat --remat-policy dots_narrow --loss-impl chunked --micro-batch 6 --label "bf16 base dots_narrow chunked mb6"; then
   :
 else
   sweep --base-dtype bf16 --remat --remat-policy dots_narrow --loss-impl chunked --micro-batch 4 --label "bf16 base dots_narrow chunked mb4"
 fi
+
+# 1b. the dots-policy family predicts 36.4% (r5_lever_rank) but measured
+# 29.1% at mb2 (small-batch MXU penalty) and OOMed by 854 MB at bf16 mb4
+# — mb3 is the untried point between
+sweep --base-dtype bf16 --remat --remat-policy dots --loss-impl chunked --micro-batch 3 --label "bf16 base dots chunked mb3"
 
 # 2. headline refresh if anything beat the committed headline
 replay_winner
