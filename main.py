@@ -63,7 +63,9 @@ def main(argv=None) -> dict:
     else:
         train_factory, eval_factory = _megatron_data(cfg, trainer)
 
-    result = trainer.fit(train_factory(), eval_factory)
+    result = trainer.fit(
+        train_factory(), eval_factory, train_iter_factory=train_factory
+    )
     logger.info(f"Result: {result}")
     return result
 

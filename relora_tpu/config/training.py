@@ -100,6 +100,19 @@ class TrainingConfig:
     keep_checkpoints: Optional[int] = None
     autoresume: bool = False
 
+    # --- resilience (train/resilience.py, train/checkpoint.py) ---
+    handle_preemption: bool = True  # SIGTERM/SIGINT -> emergency checkpoint
+    save_retries: int = 3  # checkpoint-save initiation retries
+    save_retry_backoff: float = 0.5  # seconds, doubled each retry
+    # loss-spike rollback: 0 disables; otherwise the outlier threshold in
+    # sigma-equivalents (median + threshold * 1.4826 * MAD over the window)
+    spike_threshold: float = 0.0
+    spike_window: int = 64  # rolling baseline size (update steps)
+    spike_min_history: int = 16  # updates before detection arms
+    spike_patience: int = 3  # consecutive outliers before rollback
+    spike_rollback_margin: int = 1  # extra batches skipped past the spike
+    max_spike_rollbacks: int = 3  # rollback budget per run
+
     # --- numerics ---
     dtype: str = "bfloat16"
     quantize: Optional[str] = None  # None | "int8" | "nf4"
@@ -252,6 +265,22 @@ class TrainingConfig:
                 "remat_policy must be 'full', 'dots', 'dots_narrow' or 'dots_all', "
                 f"got {self.remat_policy!r}"
             )
+
+        if self.save_retries < 0:
+            raise ValueError("save_retries must be >= 0")
+        if self.spike_threshold < 0:
+            raise ValueError("spike_threshold must be >= 0 (0 disables spike rollback)")
+        if self.spike_threshold > 0:
+            if self.spike_patience < 1:
+                raise ValueError("spike_patience must be >= 1")
+            if self.spike_min_history < 4:
+                raise ValueError("spike_min_history must be >= 4")
+            if self.spike_window < self.spike_min_history:
+                raise ValueError("spike_window must be >= spike_min_history")
+            if self.spike_rollback_margin < 0:
+                raise ValueError("spike_rollback_margin must be >= 0")
+            if self.max_spike_rollbacks < 1:
+                raise ValueError("max_spike_rollbacks must be >= 1")
 
         self._finalized = True
         return self

@@ -1,0 +1,41 @@
+"""jax version compatibility for the parallelism layer.
+
+The shard_map API moved twice across the jax versions this repo runs on:
+``jax.experimental.shard_map.shard_map`` (<= 0.4.x) became ``jax.shard_map``
+(>= 0.6), and the replication-check kwarg was renamed ``check_rep`` ->
+``check_vma``.  Call sites use the modern spelling; this wrapper translates
+for older installs.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore[no-redef]
+
+_PARAMS = inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, inside a shard_map body.
+
+    ``jax.lax.axis_size`` (>= 0.6) vs ``jax.core.axis_frame`` (0.4.x, where
+    it returns the size directly).  Both are trace-time Python ints, usable
+    for loop bounds and ppermute permutations.
+    """
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax import core
+
+    return core.axis_frame(axis_name)

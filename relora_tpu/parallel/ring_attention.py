@@ -31,7 +31,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from relora_tpu.parallel._compat import axis_size, shard_map
 
 from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS
 
@@ -110,7 +111,7 @@ def _ring_attention_local(
 ) -> jax.Array:
     """Per-device body (runs under shard_map).  q: (B, S_local, N, H);
     k/v: (B, S_local, n_kv, H) with n_kv | N."""
-    ring = jax.lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     B, S, N, H = q.shape
     n_kv = k.shape[2]
@@ -231,7 +232,7 @@ def _zz_positions(block: jax.Array, ring: int, C: int):
 def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, scale: float, tile: int):
     """Per-device body for zigzag layout.  q: (B, 2C, N, H) local;
     k/v: (B, 2C, n_kv, H) grouped."""
-    ring = jax.lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     B, S2, N, H = q.shape
     C = S2 // 2

@@ -21,8 +21,9 @@ import functools
 from typing import Optional
 
 import jax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from relora_tpu.parallel._compat import shard_map
 
 from relora_tpu.ops.attention import dot_product_attention
 from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS

@@ -21,6 +21,15 @@ Resume modes (parity: §3.5 of SURVEY.md):
   (torchrun_main.py:505-527).
 Retention: ``delete_old_checkpoints`` keeps the newest N
 (training_utils.py:406-418).
+
+Integrity: each committed checkpoint gets a ``manifest.json`` with per-array
+shapes/dtypes (from the in-memory tree at save time) and per-file
+size+crc32 (computed at the next fence, once the async write has landed).
+``get_last_checkpoint`` verifies the manifest and silently falls back to the
+previous committed checkpoint when a dir is truncated or bit-flipped —
+a torn write on a preempted host must never poison autoresume.  Save
+initiation failures (flaky NFS/GCS mounts) are retried with exponential
+backoff before giving up.
 """
 
 from __future__ import annotations
@@ -29,11 +38,14 @@ import dataclasses
 import json
 import os
 import shutil
+import time
+import zlib
 from typing import Any, Mapping, Optional, Tuple
 
 import jax
 
 from relora_tpu.core.relora import LoraSpec
+from relora_tpu.utils import faults
 from relora_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -43,6 +55,7 @@ PyTree = Any
 STATE_SUBDIR = "state"
 TRAINING_STATE_FILE = "training_state.json"
 RELORA_CONFIG_FILE = "relora_config.json"
+MANIFEST_FILE = "manifest.json"
 
 
 _CKPTR = None
@@ -63,14 +76,132 @@ def _checkpointer():
     return _CKPTR
 
 
+# checkpoint dirs whose async write has been initiated but whose manifest
+# (size+crc32 per committed file) cannot be computed until the write lands;
+# entries are (path, array_manifest) finalized at the next fence.
+_PENDING_MANIFESTS: list = []
+
+
 def wait_for_save() -> None:
     """Block until every initiated async checkpoint write has committed."""
     if _CKPTR is not None:
         _CKPTR.wait_until_finished()
+    _finalize_pending_manifests()
 
 
 def checkpoint_dir(save_dir: str, update_step: int) -> str:
     return os.path.join(save_dir, f"model_{update_step}")
+
+
+def _array_manifest(state: PyTree) -> dict:
+    """Per-leaf {shape, dtype} of the in-memory tree being saved — recorded
+    *before* serialization so restore-side shape drift is detectable even
+    when the files themselves are intact."""
+    out = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        out[jax.tree_util.keystr(keypath)] = {
+            "shape": list(shape),
+            "dtype": str(dtype) if dtype is not None else type(leaf).__name__,
+        }
+    return out
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _walk_state_files(path: str) -> dict:
+    """{relpath: {size, crc32}} for every file under ``path/state/`` plus the
+    sibling JSON files the resume path depends on."""
+    files = {}
+    state_path = os.path.join(path, STATE_SUBDIR)
+    for root, _, names in os.walk(state_path):
+        for name in names:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            files[rel] = {"size": os.path.getsize(full), "crc32": _file_crc32(full)}
+    for name in (TRAINING_STATE_FILE, RELORA_CONFIG_FILE):
+        full = os.path.join(path, name)
+        if os.path.exists(full):
+            files[name] = {"size": os.path.getsize(full), "crc32": _file_crc32(full)}
+    return files
+
+
+def _finalize_pending_manifests() -> None:
+    """Compute and atomically write ``manifest.json`` for every checkpoint
+    whose async write has now committed.  Runs at fences only, so it never
+    races the background writer; process 0 writes, matching the JSON files."""
+    global _PENDING_MANIFESTS
+    if not _PENDING_MANIFESTS:
+        return
+    pending, _PENDING_MANIFESTS = _PENDING_MANIFESTS, []
+    if jax.process_index() != 0:
+        return
+    for path, arrays in pending:
+        if not os.path.isdir(os.path.join(path, STATE_SUBDIR)):
+            logger.warning(f"checkpoint {path} never committed; no manifest written")
+            continue
+        manifest = {"arrays": arrays, "files": _walk_state_files(path)}
+        tmp = os.path.join(path, MANIFEST_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2)
+        os.replace(tmp, os.path.join(path, MANIFEST_FILE))
+        logger.info(f"checkpoint manifest committed for {path}")
+
+
+def verify_checkpoint(path: str, check_arrays: bool = False) -> Tuple[bool, str]:
+    """Integrity-check a committed checkpoint dir against its manifest.
+
+    Returns ``(ok, reason)``.  A dir without a manifest is accepted as a
+    legacy checkpoint (pre-manifest saves, or a run killed before the
+    finalizing fence) — commit-detection via ``state/`` still applies.
+    ``check_arrays`` additionally cross-checks recorded shapes/dtypes against
+    the Orbax metadata (slower; used by tests and offline tools)."""
+    state_path = os.path.join(path, STATE_SUBDIR)
+    if not os.path.isdir(state_path):
+        return False, "uncommitted: no state/ subdir"
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(manifest_path):
+        return True, "legacy checkpoint without manifest"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, rec in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(full)
+        if size != rec["size"]:
+            return False, f"size mismatch for {rel}: {size} != {rec['size']}"
+        if _file_crc32(full) != rec["crc32"]:
+            return False, f"checksum mismatch for {rel}"
+    if check_arrays:
+        import orbax.checkpoint as ocp
+
+        try:
+            meta = _metadata_tree(ocp.PyTreeCheckpointer(), os.path.abspath(state_path))
+        except Exception as e:  # orbax raises various internal types here
+            return False, f"unreadable array metadata: {e}"
+        recorded = manifest.get("arrays", {})
+        for keypath, leaf in jax.tree_util.tree_flatten_with_path(meta)[0]:
+            rec = recorded.get(jax.tree_util.keystr(keypath))
+            if rec is None:
+                continue  # manifest from an older schema; file checks carried it
+            shape = list(getattr(leaf, "shape", ()) or ())
+            if rec["shape"] != shape:
+                return False, (
+                    f"shape mismatch at {jax.tree_util.keystr(keypath)}: "
+                    f"{shape} != {rec['shape']}"
+                )
+    return True, "ok"
 
 
 def save_checkpoint(
@@ -79,28 +210,58 @@ def save_checkpoint(
     state: PyTree,
     training_state: dict,
     lora_spec: Optional[LoraSpec] = None,
+    retries: int = 3,
+    retry_backoff: float = 0.5,
 ) -> str:
     """Write one checkpoint dir; returns its path.  Safe to call from every
     process — Orbax coordinates the multi-host write; JSON goes from
-    process 0 only."""
+    process 0 only.
+
+    Save *initiation* (clearing a stale dir, the device->host copy, the JSON
+    sidecars) is retried ``retries`` times with exponential backoff — these
+    are the synchronous touchpoints where a flaky filesystem surfaces.  A
+    failure of the *background* write is caught downstream instead: the dir
+    never gains a committed ``state/`` (or fails manifest verification) and
+    autoresume skips it."""
     path = checkpoint_dir(save_dir, update_step)
-    os.makedirs(path, exist_ok=True)
     ckptr = _checkpointer()
     # fence the previous in-flight save (usually a no-op: saves are far
     # apart), then initiate this one — save() returns after the d2h copy,
     # the disk write proceeds in the background.  Orbax writes to a tmp dir
     # and renames on commit, so ``state/`` appears atomically.
     ckptr.wait_until_finished()
+    _finalize_pending_manifests()
     state_path = os.path.abspath(os.path.join(path, STATE_SUBDIR))
-    if os.path.exists(state_path):
-        shutil.rmtree(state_path)
-    ckptr.save(state_path, state)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, TRAINING_STATE_FILE), "w") as f:
-            json.dump(training_state, f, indent=2)
-        if lora_spec is not None:
-            with open(os.path.join(path, RELORA_CONFIG_FILE), "w") as f:
-                json.dump(dataclasses.asdict(lora_spec), f, indent=2)
+    for attempt in range(retries + 1):
+        try:
+            faults.maybe_fail("ckpt_save")
+            os.makedirs(path, exist_ok=True)
+            if os.path.exists(state_path):
+                shutil.rmtree(state_path)
+            ckptr.save(state_path, state)
+            if jax.process_index() == 0:
+                with open(os.path.join(path, TRAINING_STATE_FILE), "w") as f:
+                    json.dump(training_state, f, indent=2)
+                if lora_spec is not None:
+                    with open(os.path.join(path, RELORA_CONFIG_FILE), "w") as f:
+                        json.dump(dataclasses.asdict(lora_spec), f, indent=2)
+            break
+        except (OSError, ValueError) as e:
+            # don't leave a background write racing the retry's rmtree
+            ckptr.wait_until_finished()
+            if attempt >= retries:
+                logger.error(
+                    f"checkpoint save at step {update_step} failed after "
+                    f"{retries + 1} attempts: {e}"
+                )
+                raise
+            delay = retry_backoff * (2**attempt)
+            logger.warning(
+                f"checkpoint save attempt {attempt + 1}/{retries + 1} failed "
+                f"({e}); retrying in {delay:.1f}s"
+            )
+            time.sleep(delay)
+    _PENDING_MANIFESTS.append((path, _array_manifest(state)))
     logger.info(f"Saving checkpoint to {path} (async)")
     return path
 
@@ -113,6 +274,7 @@ def restore_checkpoint(path: str, abstract_state: PyTree) -> PyTree:
     shards directly on the mesh."""
     ckptr = _checkpointer()
     ckptr.wait_until_finished()  # same-process restore right after a save
+    _finalize_pending_manifests()
     return ckptr.restore(os.path.abspath(os.path.join(path, STATE_SUBDIR)), abstract_state)
 
 
@@ -130,13 +292,27 @@ def restore_state_host(path: str) -> PyTree:
     if not os.path.isdir(state_path):
         raise FileNotFoundError(f"no checkpoint state at {state_path}")
     ckptr = ocp.PyTreeCheckpointer()
-    item_metadata = ckptr.metadata(state_path).item_metadata
-    if item_metadata is None:
-        raise FileNotFoundError(f"checkpoint at {state_path} has no readable metadata")
+    meta_tree = _metadata_tree(ckptr, state_path)
     restore_args = jax.tree_util.tree_map(
-        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item_metadata.tree
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree
     )
     return ckptr.restore(state_path, restore_args=restore_args)
+
+
+def _metadata_tree(ckptr, state_path: str) -> PyTree:
+    """Per-leaf metadata pytree of a saved checkpoint, across orbax versions
+    (newer orbax wraps it in ``.item_metadata.tree``; 0.7.x returns the tree
+    directly)."""
+    meta = ckptr.metadata(state_path)
+    item_metadata = getattr(meta, "item_metadata", None)
+    if item_metadata is not None:
+        tree = getattr(item_metadata, "tree", item_metadata)
+        if tree is None:
+            raise FileNotFoundError(f"checkpoint at {state_path} has no readable metadata")
+        return tree
+    if meta is None:
+        raise FileNotFoundError(f"checkpoint at {state_path} has no readable metadata")
+    return meta
 
 
 def restore_params_host(path: str) -> PyTree:
@@ -162,17 +338,42 @@ def load_lora_spec(path: str) -> Optional[LoraSpec]:
         return LoraSpec(**json.load(f))
 
 
-def get_last_checkpoint(save_dir: str) -> Tuple[Optional[dict], Optional[str]]:
-    """Find the newest ``model_{step}`` dir and its training_state.json
-    (parity: training_utils.get_last_training_state :248-264)."""
+def get_last_checkpoint(
+    save_dir: str, before_step: Optional[int] = None
+) -> Tuple[Optional[dict], Optional[str]]:
+    """Find the newest *verified* ``model_{step}`` dir and its
+    training_state.json (parity: training_utils.get_last_training_state
+    :248-264).
+
+    Candidates are tried newest-first; a dir that fails manifest
+    verification or has an unreadable ``training_state.json`` is skipped
+    with a warning and the previous committed checkpoint is used instead —
+    a half-written or bit-flipped checkpoint must degrade resume, not break
+    it.  ``before_step`` restricts the search to checkpoints with step
+    strictly below it (the spike-rollback path: the spike's own checkpoint
+    is not a valid rollback target)."""
     if not os.path.isdir(save_dir):
         return None, None
     dirs = _committed_checkpoints(save_dir)
+    if before_step is not None:
+        dirs = [d for d in dirs if int(d.split("_")[-1]) < before_step]
     if not dirs:
         logger.warning(f"Save directory {save_dir} exists but has no checkpoints; starting fresh")
         return None, None
-    path = os.path.join(save_dir, dirs[-1])
-    return load_training_state(path), path
+    for d in reversed(dirs):
+        path = os.path.join(save_dir, d)
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            logger.warning(f"Skipping corrupt checkpoint {path}: {reason}")
+            continue
+        try:
+            return load_training_state(path), path
+        except (OSError, json.JSONDecodeError, KeyError) as e:
+            logger.warning(f"Skipping checkpoint {path} with unreadable training state: {e}")
+    logger.warning(
+        f"Save directory {save_dir} has checkpoints but none passed verification; starting fresh"
+    )
+    return None, None
 
 
 def _committed_checkpoints(save_dir: str) -> list:

@@ -117,6 +117,7 @@ def make_train_step(
     loss_impl: str = "dense",  # dense | chunked (streamed vocab CE)
     vocab_chunk: int = 8192,
     log_per_layer_scaling: bool = False,
+    nan_grad_steps: Tuple[int, ...] = (),
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -127,6 +128,11 @@ def make_train_step(
     with donated state, e.g.::
 
         step = jax.jit(make_train_step(...), donate_argnums=0)
+
+    ``nan_grad_steps`` (fault injection, utils/faults.py): device step
+    counts at which the accumulated gradients are poisoned with NaN before
+    clipping, exercising the NaN gate exactly where a real overflow would
+    hit it.  Empty (the default) compiles to nothing.
     """
 
     loss_fn = _make_loss_fn(
@@ -154,6 +160,14 @@ def make_train_step(
         )
         grads = jax.tree_util.tree_map(lambda g: g / ga, grads)
         mean_loss = loss_sum / ga
+
+        if nan_grad_steps:
+            poison = functools.reduce(
+                jnp.logical_or, [state.step == s for s in nan_grad_steps]
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(poison, jnp.full_like(g, jnp.nan), g), grads
+            )
 
         if clip_grad_norm > 0:
             grads, grad_norm = clip_by_global_norm(grads, clip_grad_norm)

@@ -52,8 +52,10 @@ from relora_tpu.parallel.mesh import (
     param_shardings,
 )
 from relora_tpu.train import checkpoint as ckpt
+from relora_tpu.train.resilience import LossSpikeDetector, PreemptionGuard, SpikeEvent
 from relora_tpu.train.state import TrainState
 from relora_tpu.train.step import make_eval_step, make_train_step, make_watch_histograms
+from relora_tpu.utils import faults
 from relora_tpu.utils.logging import MetricsLogger, get_logger, set_process_index
 
 logger = get_logger(__name__)
@@ -185,6 +187,7 @@ class Trainer:
         self.tokens_seen_before = 0
         self.n_lora_restarts = 0
         self.n_optimizer_resets = 0
+        self.n_spike_rollbacks = 0
         self._local_updates = 0
         self._resumed = False
         self._wandb_id: Optional[str] = None
@@ -228,6 +231,11 @@ class Trainer:
             self.tokens_seen_before = ts.get("tokens_seen_before", 0)
             self.n_lora_restarts = ts.get("n_lora_restarts", 0)
             self.n_optimizer_resets = ts.get("n_optimizer_resets", 0)
+            self.n_spike_rollbacks = ts.get("n_spike_rollbacks", 0)
+            # a previous run's automatic spike rollback may have extended the
+            # blacklist; without merging it a restart would replay the
+            # poisoned window
+            cfg.skip_batches |= set(ts.get("skip_batches") or ())
             self._wandb_id = ts.get("wandb_id")
             self._resumed = True
             # Keep the schedule identical across restarts: restore the
@@ -314,6 +322,7 @@ class Trainer:
                 loss_impl=cfg.loss_impl,
                 vocab_chunk=cfg.vocab_chunk,
                 log_per_layer_scaling=cfg.train_scaling,
+                nan_grad_steps=faults.nan_grad_steps(),
             ),
             donate_argnums=0,
         )
@@ -479,14 +488,40 @@ class Trainer:
             yield out
 
     # ------------------------------------------------------------------
-    def fit(self, train_iter: Iterator[np.ndarray], eval_iter_factory=None) -> dict:
-        """The update loop (parity: torchrun_main.py:768-947)."""
+    def fit(
+        self,
+        train_iter: Iterator[np.ndarray],
+        eval_iter_factory=None,
+        train_iter_factory=None,
+    ) -> dict:
+        """The update loop (parity: torchrun_main.py:768-947).
+
+        ``train_iter_factory`` (optional) rebuilds the training iterator from
+        the trainer's *current* counters — required for automatic loss-spike
+        rollback, which rewinds ``update_step`` and needs the data stream
+        re-aligned to it.  Without it, spikes are detected and logged but not
+        rolled back.  SIGTERM/SIGINT during the loop triggers a graceful
+        emergency checkpoint at the next update boundary
+        (``cfg.handle_preemption``); the result dict reports ``preempted``.
+        """
         cfg = self.cfg
         exhausted = True  # for-else: did the data run out before the step budget?
         update_start = time.time()
         rng = jax.random.PRNGKey(cfg.seed + 1)
         saved_at = -1
         aborted = False
+        preempted = False
+        detector = (
+            LossSpikeDetector(
+                cfg.spike_threshold,
+                window=cfg.spike_window,
+                min_history=cfg.spike_min_history,
+                patience=cfg.spike_patience,
+            )
+            if cfg.spike_threshold > 0
+            else None
+        )
+        spike: Optional[SpikeEvent] = None
 
         from relora_tpu.utils.profiling import maybe_make_profiler
 
@@ -507,7 +542,7 @@ class Trainer:
 
         def flush_pending() -> bool:
             """Log the lagged metrics; returns False if training must abort."""
-            nonlocal pending
+            nonlocal pending, spike
             if pending is None:
                 return True
             metrics, at_step, at_global, tokens_in_update, dt, counters = pending
@@ -517,11 +552,17 @@ class Trainer:
                     f"NaN update skipped at step {at_step} "
                     f"({int(metrics['n_skipped'])} total)"
                 )
+                self.metrics.event(
+                    "nan_skip", step=at_step, n_skipped=int(metrics["n_skipped"])
+                )
                 if int(metrics["n_skipped"]) > cfg.nan_abort_fraction * cfg.num_training_steps:
                     logger.error("More than 5% of updates NaN-skipped; aborting")
                     return False
+            loss_val = faults.perturb("loss", float(metrics["loss"]), step=at_step)
+            if detector is not None and spike is None:
+                spike = detector.update(at_step, loss_val)
             record = {
-                "loss": float(metrics["loss"]),
+                "loss": loss_val,
                 "lr": float(metrics.get("lr", 0.0)),
                 "update_step": at_step,
                 "tokens_seen": self.tokens_seen,
@@ -546,134 +587,182 @@ class Trainer:
             # already-finished run (e.g. autoresume past the budget): don't
             # pull/transfer any data
             train_iter = iter(())
-        for batch in self._prefetched(train_iter):
-            if self.update_step >= cfg.num_training_steps:
-                exhausted = False
-                break
-            if self.update_step in cfg.skip_batches:
-                # manual loss-spike blacklist (torchrun_main.py:772-775):
-                # the batch is consumed (data stream stays aligned) but its
-                # transfer is wasted — acceptable for a rare manual blacklist
+        with PreemptionGuard(enabled=cfg.handle_preemption) as guard:
+          # the while wrapper exists solely for spike rollback: a rollback
+          # rewinds counters and restarts the for loop on a rebuilt iterator
+          while True:
+            restart = False
+            exhausted = True
+            for batch in self._prefetched(train_iter):
+                if self.update_step >= cfg.num_training_steps:
+                    exhausted = False
+                    break
+                if self.update_step in cfg.skip_batches:
+                    # loss-spike blacklist, manual (torchrun_main.py:772-775)
+                    # or auto-extended by rollback: the batch is consumed
+                    # (data stream stays aligned) but its transfer is wasted
+                    # — acceptable for a rare blacklist
+                    self.metrics.event("batch_skipped", step=self.update_step)
+                    self.update_step += 1
+                    self.global_step += self.grad_accum
+                    continue
+
+                self.tokens_seen += int(batch.size)
+
+                self.state, metrics = self._train_step(
+                    self.state, batch, jax.random.fold_in(rng, self.update_step)
+                )
                 self.update_step += 1
+                self._local_updates += 1
                 self.global_step += self.grad_accum
-                continue
 
-            self.tokens_seen += int(batch.size)
+                # ---- graceful preemption --------------------------------
+                faults.tick("preempt", self.update_step)
+                if guard.requested:
+                    self.metrics.event(
+                        "preemption", step=self.update_step, signum=guard.signum
+                    )
+                    flush_pending()
+                    if cfg.save_dir:
+                        path = self.save(time.time() - update_start)
+                        if path:
+                            saved_at = self.update_step
+                            self.metrics.event(
+                                "emergency_checkpoint",
+                                step=self.update_step,
+                                path=path,
+                            )
+                    preempted = True
+                    exhausted = False
+                    break
 
-            self.state, metrics = self._train_step(
-                self.state, batch, jax.random.fold_in(rng, self.update_step)
-            )
-            self.update_step += 1
-            self._local_updates += 1
-            self.global_step += self.grad_accum
+                # ---- save -----------------------------------------------
+                if (
+                    cfg.save_dir
+                    and cfg.save_every > 0
+                    and self._local_updates > 1
+                    and self.update_step % cfg.save_every == 0
+                ):
+                    if self.save(time.time() - update_start):
+                        saved_at = self.update_step
 
-            # ---- save ----------------------------------------------------
-            if (
-                cfg.save_dir
-                and cfg.save_every > 0
-                and self._local_updates > 1
-                and self.update_step % cfg.save_every == 0
-            ):
-                self.save(time.time() - update_start)
-                saved_at = self.update_step
+                # ---- eval -----------------------------------------------
+                if (
+                    eval_iter_factory is not None
+                    and cfg.eval_every > 0
+                    and self.update_step % cfg.eval_every == 0
+                ):
+                    eval_loss, eval_tokens = self.evaluate(
+                        eval_iter_factory(), cfg.eval_tokens_during_training
+                    )
+                    self.metrics.log(
+                        {"final_eval_loss": eval_loss, "final_eval_tokens": eval_tokens},
+                        step=self.global_step,
+                    )
+                    logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
 
-            # ---- eval ----------------------------------------------------
-            if (
-                eval_iter_factory is not None
-                and cfg.eval_every > 0
-                and self.update_step % cfg.eval_every == 0
-            ):
-                eval_loss, eval_tokens = self.evaluate(
-                    eval_iter_factory(), cfg.eval_tokens_during_training
-                )
-                self.metrics.log(
-                    {"final_eval_loss": eval_loss, "final_eval_tokens": eval_tokens},
-                    step=self.global_step,
-                )
-                logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
-
-            # ---- wandb.watch histograms (torchrun_main.py:624-627) -------
-            if (
-                self._watch_step is not None
-                and cfg.eval_every > 0
-                and self.update_step % cfg.eval_every == 0
-            ):
-                hists = self._watch_step(
-                    self.state.params,
-                    batch[0],
-                    jax.random.fold_in(rng, 2**30 + self.update_step),
-                )
-                # one bulk transfer: per-element int()/float() on device
-                # arrays would sync once per bin through the TPU tunnel
-                self.metrics.log_histograms(
-                    jax.device_get(hists), step=self.global_step
-                )
-
-            # ---- ReLoRA merge (torchrun_main.py:874-893) ----------------
-            relora_every = cfg.relora  # 0 normalized to None in finalize
-            can_merge = relora_every is not None and (
-                self._resumed or self._local_updates >= relora_every
-            )
-            if can_merge and (self.update_step - self.scheduler_start_step) % relora_every == 1:
-                t0 = time.time()
-                self.n_lora_restarts += 1
-                self.state = self.state.replace(
-                    params=self._merge_fn(
+                # ---- wandb.watch histograms (torchrun_main.py:624-627) --
+                if (
+                    self._watch_step is not None
+                    and cfg.eval_every > 0
+                    and self.update_step % cfg.eval_every == 0
+                ):
+                    hists = self._watch_step(
                         self.state.params,
-                        jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 2), self.update_step),
+                        batch[0],
+                        jax.random.fold_in(rng, 2**30 + self.update_step),
                     )
-                )
-                jax.block_until_ready(self.state.params)
-                logger.info(
-                    f"LoRA merge #{self.n_lora_restarts} at update {self.update_step} "
-                    f"took {time.time() - t0:.2f}s"
-                )
-
-            # ---- optimizer reset (torchrun_main.py:895-912) -------------
-            cycle = cfg.cycle_length or cfg.relora
-            can_reset = cfg.relora is not None and cycle is not None and (
-                self._resumed or self._local_updates >= cycle
-            )
-            if can_reset and (self.update_step - self.scheduler_start_step) % cycle == 1:
-                self.n_optimizer_resets += 1
-                reset_rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 3), self.update_step)
-                self.state = self.state.replace(
-                    opt_state=self._reset_fn(self.state.opt_state, rng=reset_rng)
-                )
-                z = float(zeroed_fraction(self.state.opt_state))
-                logger.info(
-                    f"Optimizer reset #{self.n_optimizer_resets} "
-                    f"({cfg.optimizer_reset_mode}) at update {self.update_step}: "
-                    f"{z*100:.2f}% of moments zero"
-                )
-                # post-reset LR sanity (training_utils.py:391-404)
-                lr_now = float(self.schedule(jnp.asarray(self.update_step - self.scheduler_start_step)))
-                if lr_now > self.cfg.lr:
-                    self.metrics.alert(
-                        "Learning rate issue",
-                        f"LR after reset is {lr_now} > max {self.cfg.lr}",
+                    # one bulk transfer: per-element int()/float() on device
+                    # arrays would sync once per bin through the TPU tunnel
+                    self.metrics.log_histograms(
+                        jax.device_get(hists), step=self.global_step
                     )
 
-            # ---- metrics (torchrun_main.py:918-943), one-step lagged -----
-            if not flush_pending():
-                exhausted = False
-                aborted = True
-                break
-            update_time = time.time() - update_start
-            update_start = time.time()
-            tokens_in_update = self.tokens_seen - self.tokens_seen_before
-            self.tokens_seen_before = self.tokens_seen
-            pending = (
-                metrics,
-                self.update_step,
-                self.global_step,
-                tokens_in_update,
-                update_time,
-                {
-                    "n_lora_restarts": self.n_lora_restarts,
-                    "n_optimizer_resets": self.n_optimizer_resets,
-                },
-            )
+                # ---- ReLoRA merge (torchrun_main.py:874-893) ------------
+                relora_every = cfg.relora  # 0 normalized to None in finalize
+                can_merge = relora_every is not None and (
+                    self._resumed or self._local_updates >= relora_every
+                )
+                if can_merge and (self.update_step - self.scheduler_start_step) % relora_every == 1:
+                    t0 = time.time()
+                    self.n_lora_restarts += 1
+                    self.state = self.state.replace(
+                        params=self._merge_fn(
+                            self.state.params,
+                            jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 2), self.update_step),
+                        )
+                    )
+                    jax.block_until_ready(self.state.params)
+                    logger.info(
+                        f"LoRA merge #{self.n_lora_restarts} at update {self.update_step} "
+                        f"took {time.time() - t0:.2f}s"
+                    )
+
+                # ---- optimizer reset (torchrun_main.py:895-912) ---------
+                cycle = cfg.cycle_length or cfg.relora
+                can_reset = cfg.relora is not None and cycle is not None and (
+                    self._resumed or self._local_updates >= cycle
+                )
+                if can_reset and (self.update_step - self.scheduler_start_step) % cycle == 1:
+                    self.n_optimizer_resets += 1
+                    reset_rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 3), self.update_step)
+                    self.state = self.state.replace(
+                        opt_state=self._reset_fn(self.state.opt_state, rng=reset_rng)
+                    )
+                    z = float(zeroed_fraction(self.state.opt_state))
+                    logger.info(
+                        f"Optimizer reset #{self.n_optimizer_resets} "
+                        f"({cfg.optimizer_reset_mode}) at update {self.update_step}: "
+                        f"{z*100:.2f}% of moments zero"
+                    )
+                    # post-reset LR sanity (training_utils.py:391-404)
+                    lr_now = float(self.schedule(jnp.asarray(self.update_step - self.scheduler_start_step)))
+                    if lr_now > self.cfg.lr:
+                        self.metrics.alert(
+                            "Learning rate issue",
+                            f"LR after reset is {lr_now} > max {self.cfg.lr}",
+                        )
+
+                # ---- metrics (torchrun_main.py:918-943), one-step lagged -
+                if not flush_pending():
+                    exhausted = False
+                    aborted = True
+                    break
+                update_time = time.time() - update_start
+                update_start = time.time()
+                tokens_in_update = self.tokens_seen - self.tokens_seen_before
+                self.tokens_seen_before = self.tokens_seen
+                pending = (
+                    metrics,
+                    self.update_step,
+                    self.global_step,
+                    tokens_in_update,
+                    update_time,
+                    {
+                        "n_lora_restarts": self.n_lora_restarts,
+                        "n_optimizer_resets": self.n_optimizer_resets,
+                    },
+                )
+
+                # ---- loss-spike rollback --------------------------------
+                if spike is not None:
+                    ev, spike = spike, None
+                    rolled_back = self._handle_spike(
+                        ev, can_realign=train_iter_factory is not None
+                    )
+                    detector.reset_streak()
+                    if rolled_back:
+                        # drop the post-spike step's lagged metrics — the
+                        # step it describes was just undone
+                        pending = None
+                        restart = True
+                        exhausted = False
+                        break
+            if restart:
+                train_iter = train_iter_factory()
+                update_start = time.time()
+                continue
+            break
         if not flush_pending():
             aborted = True
         if prof is not None:
@@ -689,9 +778,11 @@ class Trainer:
             "update_step": self.update_step,
             "tokens_seen": self.tokens_seen,
             "aborted": aborted,
+            "preempted": preempted,
+            "n_rollbacks": self.n_spike_rollbacks,
             "n_skipped": int(self.state.n_skipped),
         }
-        if eval_iter_factory is not None:
+        if eval_iter_factory is not None and not preempted:
             final_loss, final_tokens = self.evaluate(
                 eval_iter_factory(), target_tokens=cfg.final_eval_tokens
             )
@@ -781,6 +872,81 @@ class Trainer:
         return loss_sum / max(n_tokens, 1.0), n_tokens
 
     # ------------------------------------------------------------------
+    def _handle_spike(self, spike: SpikeEvent, can_realign: bool) -> bool:
+        """Roll back to the last committed checkpoint preceding the spike and
+        blacklist the poisoned update window.  Returns True when a rollback
+        happened (the caller must rebuild the data iterator); on False the
+        spike is logged and training continues forward."""
+        cfg = self.cfg
+        self.metrics.event(
+            "loss_spike",
+            step=spike.last_step,
+            first_step=spike.first_step,
+            last_step=spike.last_step,
+            loss=spike.loss,
+            median=spike.median,
+            mad=spike.mad,
+        )
+        logger.error(
+            f"Sustained loss spike over updates {spike.first_step}..{spike.last_step} "
+            f"(loss={spike.loss:.4f}, baseline median={spike.median:.4f}, "
+            f"mad={spike.mad:.4f})"
+        )
+        reason = None
+        if self.n_spike_rollbacks >= cfg.max_spike_rollbacks:
+            reason = f"rollback budget exhausted ({cfg.max_spike_rollbacks})"
+        elif not can_realign:
+            reason = "no train_iter_factory to realign the data stream"
+        elif not cfg.save_dir:
+            reason = "no save_dir to roll back to"
+        if reason is None:
+            # the spike's own steps may have just been checkpointed; only a
+            # checkpoint strictly before the spike is a valid target
+            ckpt.wait_for_save()
+            ts, target = ckpt.get_last_checkpoint(
+                cfg.save_dir, before_step=spike.first_step
+            )
+            if target is None:
+                reason = "no committed checkpoint precedes the spike"
+        if reason is not None:
+            logger.error(f"Loss spike NOT rolled back: {reason}")
+            self.metrics.event("rollback_skipped", step=spike.last_step, reason=reason)
+            return False
+        # skip indices are matched against the pre-increment counter, so
+        # skipping index k suppresses logged update k+1: the spiked logged
+        # window [first, last] maps to indices [first-1, last-1], and the
+        # margin extends the blacklist past the last observed outlier
+        new_skips = set(
+            range(spike.first_step - 1, spike.last_step + cfg.spike_rollback_margin)
+        )
+        cfg.skip_batches |= new_skips
+        self.state = self._normalize_placement(ckpt.restore_checkpoint(target, self.state))
+        self.update_step = ts["update_step"]
+        self.global_step = ts["global_step"]
+        self.tokens_seen = ts["tokens_seen"]
+        self.tokens_seen_before = ts.get("tokens_seen_before", self.tokens_seen)
+        self.n_lora_restarts = ts.get("n_lora_restarts", self.n_lora_restarts)
+        self.n_optimizer_resets = ts.get("n_optimizer_resets", self.n_optimizer_resets)
+        # same trigger gating as a process-restart resume: the first partial
+        # cycle after the rollback point completes before new merges/resets
+        self._local_updates = 0
+        self._resumed = True
+        self.n_spike_rollbacks += 1
+        self.metrics.event(
+            "rollback",
+            step=self.update_step,
+            target=target,
+            skip_batches=sorted(new_skips),
+            n_spike_rollbacks=self.n_spike_rollbacks,
+        )
+        logger.warning(
+            f"Rolled back to {target} (update {self.update_step}); "
+            f"blacklisted batch indices {sorted(new_skips)} "
+            f"(rollback {self.n_spike_rollbacks}/{cfg.max_spike_rollbacks})"
+        )
+        return True
+
+    # ------------------------------------------------------------------
     def save(self, update_time: float = 0.0) -> str:
         training_state = {
             "global_step": self.global_step,
@@ -791,16 +957,30 @@ class Trainer:
             "n_optimizer_resets": self.n_optimizer_resets,
             "update_time": update_time,
             "wandb_id": self._wandb_id,
-            # extension over the reference schema: lets resume rebuild the
-            # exact same LR schedule (see __init__)
+            # extensions over the reference schema: the schedule origin lets
+            # resume rebuild the exact same LR schedule (see __init__), and
+            # the blacklist/rollback counters make automatic spike recovery
+            # survive a process restart
             "scheduler_start_step": self.scheduler_start_step,
+            "skip_batches": sorted(self.cfg.skip_batches),
+            "n_spike_rollbacks": self.n_spike_rollbacks,
         }
-        path = ckpt.save_checkpoint(
-            self.cfg.save_dir,
-            self.update_step,
-            self.state,
-            training_state,
-            self.lora_spec,
-        )
+        try:
+            path = ckpt.save_checkpoint(
+                self.cfg.save_dir,
+                self.update_step,
+                self.state,
+                training_state,
+                self.lora_spec,
+                retries=self.cfg.save_retries,
+                retry_backoff=self.cfg.save_retry_backoff,
+            )
+        except (OSError, ValueError) as e:
+            # a lost periodic checkpoint must not kill a long run: the
+            # previous committed checkpoint stays the resume target and the
+            # next save cadence tries again
+            logger.error(f"Checkpoint save at step {self.update_step} abandoned: {e}")
+            self.metrics.event("save_failed", step=self.update_step, error=str(e))
+            return ""
         ckpt.delete_old_checkpoints(self.cfg.save_dir, self.cfg.keep_checkpoints)
         return path

@@ -1,0 +1,165 @@
+"""Fault-injection harness for the resilience subsystem.
+
+Production training must survive flaky filesystems, preemptions, NaN
+gradients and data-induced loss spikes; this module makes every one of those
+failures *reproducible* so the recovery paths (checkpoint retries, integrity
+fallback, emergency saves, spike rollback) are exercised by tier-1 tests
+instead of discovered in production.
+
+Hook points are compiled into the trainer/checkpoint layers as cheap
+host-side calls that are no-ops until a fault is armed:
+
+- ``maybe_fail("ckpt_save")``     — raise an injected ``OSError`` at
+  checkpoint-save initiation (``times=N`` consecutive failures); exercises
+  the retry-with-backoff path in ``train/checkpoint.py``.
+- ``perturb("loss", v, step=s)``  — add ``delta`` to the logged loss metric
+  at the configured update steps; exercises the spike detector + rollback.
+- ``tick("preempt", step=s)``     — deliver a real ``SIGTERM`` to this
+  process once, at update step ``at``; exercises the graceful-preemption
+  path end to end (signal handler -> emergency checkpoint -> resume).
+- ``nan_grad_steps()``            — update steps at which the train step
+  poisons its gradients with NaN (compiled statically into the step by the
+  Trainer); exercises the NaN gate and its counter persistence.
+
+Configuration is programmatic (``configure``/``reset``, used by tests) or
+via the ``RELORA_TPU_FAULTS`` env var for CLI runs, e.g.::
+
+    RELORA_TPU_FAULTS="ckpt_save:times=2;preempt:at=500;loss:steps=100-110,delta=8"
+
+Never arm faults in a production launch; the env knob exists for drills.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Optional
+
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_FAULTS: dict[str, dict] = {}
+_FIRED: dict[str, int] = {}
+
+_EXC_NAMES = {
+    "oserror": OSError,
+    "ioerror": IOError,
+    "timeout": TimeoutError,
+}
+
+
+def configure(site: str, **spec: Any) -> None:
+    """Arm a fault at ``site``.  Recognized spec keys (site-dependent):
+    ``times`` (int, error count), ``exc`` (exception class), ``steps``
+    (iterable of update steps), ``delta`` (float), ``at`` (int step),
+    ``sig`` (signal number, default SIGTERM)."""
+    if "steps" in spec and spec["steps"] is not None:
+        spec["steps"] = frozenset(int(s) for s in spec["steps"])
+    _FAULTS[site] = spec
+    _FIRED.setdefault(site, 0)
+    logger.warning(f"fault armed: {site} {spec}")
+
+
+def reset() -> None:
+    """Disarm everything (autouse-fixture friendly)."""
+    _FAULTS.clear()
+    _FIRED.clear()
+
+
+def active(site: Optional[str] = None) -> bool:
+    return bool(_FAULTS) if site is None else site in _FAULTS
+
+
+def fire_count(site: str) -> int:
+    return _FIRED.get(site, 0)
+
+
+def maybe_fail(site: str) -> None:
+    """Raise the armed exception at ``site`` for the first ``times`` calls."""
+    spec = _FAULTS.get(site)
+    if spec is None:
+        return
+    times = int(spec.get("times", 1))
+    if _FIRED.get(site, 0) >= times:
+        return
+    _FIRED[site] = _FIRED.get(site, 0) + 1
+    exc = spec.get("exc", OSError)
+    raise exc(f"injected fault at {site!r} ({_FIRED[site]}/{times})")
+
+
+def perturb(site: str, value: float, step: Optional[int] = None) -> float:
+    """Add the armed ``delta`` to ``value`` when ``step`` is in ``steps``
+    (or unconditionally when no steps are configured)."""
+    spec = _FAULTS.get(site)
+    if spec is None:
+        return value
+    steps = spec.get("steps")
+    if steps is not None and step not in steps:
+        return value
+    _FIRED[site] = _FIRED.get(site, 0) + 1
+    return value + float(spec.get("delta", 0.0))
+
+
+def tick(site: str, step: int) -> None:
+    """Step-boundary hook.  For ``"preempt"``: deliver the configured signal
+    to this process once, when ``step`` reaches ``at`` — a real signal, so
+    the production handler path (not a shortcut) is what gets tested."""
+    spec = _FAULTS.get(site)
+    if spec is None:
+        return
+    at = spec.get("at")
+    if at is None or step < int(at) or _FIRED.get(site, 0) > 0:
+        return
+    _FIRED[site] = _FIRED.get(site, 0) + 1
+    sig = int(spec.get("sig", signal.SIGTERM))
+    logger.warning(f"fault {site!r}: sending signal {sig} at step {step}")
+    os.kill(os.getpid(), sig)
+
+
+def nan_grad_steps() -> tuple:
+    """Update steps (device step counter) at which the train step should
+    poison its gradients with NaN.  Read once at Trainer build time and
+    compiled statically into the step — an unarmed run pays nothing."""
+    spec = _FAULTS.get("nan_grads")
+    if spec is None:
+        return ()
+    return tuple(sorted(spec.get("steps") or ()))
+
+
+def configure_from_env(env: Optional[str] = None) -> None:
+    """Parse ``RELORA_TPU_FAULTS`` (see module docstring for the syntax).
+
+    ``steps`` accepts comma-free range syntax ``a-b`` (inclusive) or a single
+    int; ``exc`` accepts the names in ``_EXC_NAMES``.
+    """
+    raw = env if env is not None else os.environ.get("RELORA_TPU_FAULTS", "")
+    if not raw:
+        return
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, body = part.partition(":")
+        spec: dict[str, Any] = {}
+        for kv in filter(None, body.split(",")):
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k == "steps":
+                lo, dash, hi = v.partition("-")
+                spec["steps"] = (
+                    range(int(lo), int(hi) + 1) if dash else (int(lo),)
+                )
+            elif k == "exc":
+                spec["exc"] = _EXC_NAMES.get(v.lower(), OSError)
+            elif k in ("times", "at", "sig"):
+                spec[k] = int(v)
+            elif k == "delta":
+                spec[k] = float(v)
+            else:
+                logger.warning(f"unknown fault spec key {k!r} in {part!r}; ignored")
+        configure(site.strip(), **spec)
+
+
+configure_from_env()

@@ -167,6 +167,22 @@ class MetricsLogger:
                 step=step,
             )
 
+    def event(self, kind: str, step: Optional[int] = None, **fields: Any) -> None:
+        """Structured lifecycle event (preemption, emergency_checkpoint,
+        loss_spike, rollback, save_failed, ...): a JSONL record with
+        ``_event: kind`` so postmortem tools can grep the run's incident
+        timeline out of the metric stream."""
+        if not self.enabled:
+            return
+        get_logger().info(f"event {kind}: {fields}")
+        record = {"_event": kind, **{k: _to_scalar(v) for k, v in fields.items()}}
+        if step is not None:
+            record["_step"] = step
+        record["_time"] = time.time()
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+
     def alert(self, title: str, text: str) -> None:
         """Parity: wandb.alert on bad post-reset LR (training_utils.py:397-404)."""
         get_logger().warning(f"ALERT [{title}]: {text}")

@@ -55,13 +55,17 @@ SUFFIX=""
 [ "$RESTART_WARMUP" != "100" ] && SUFFIX="${SUFFIX}_rw${RESTART_WARMUP}"
 [ -n "$OPT_PRUNE" ] && SUFFIX="${SUFFIX}_mag${OPT_PRUNE}"
 # The corpus build (tools/build_text_corpus.py) writes <out>.meta.json as
-# its final act — wait for it (bounded) instead of failing when this script
-# is launched while a fresh-sandbox rebuild is still training the BPE
-# tokenizer.  WAIT_CORPUS_SECS=0 restores fail-fast.
-WAIT_CORPUS_SECS="${WAIT_CORPUS_SECS:-5400}"
+# its final act.  Default is fail-fast: a missing corpus usually means a
+# wrong CORPUS path, and silently sleeping 90 minutes on a typo wastes the
+# whole queue window.  Launchers that intentionally race a fresh-sandbox
+# corpus rebuild opt in with e.g. WAIT_CORPUS_SECS=5400.
+WAIT_CORPUS_SECS="${WAIT_CORPUS_SECS:-0}"
 waited=0
 while [ ! -f "${CORPUS}.meta.json" ] && [ "$waited" -lt "$WAIT_CORPUS_SECS" ]; do
   [ "$waited" -eq 0 ] && echo "waiting for corpus ${CORPUS}.meta.json (up to ${WAIT_CORPUS_SECS}s) ..."
+  # periodic progress so a tailed log shows the wait is alive, not hung
+  [ "$waited" -gt 0 ] && [ $((waited % 300)) -eq 0 ] && \
+    echo "still waiting for corpus ${CORPUS}.meta.json (${waited}/${WAIT_CORPUS_SECS}s) ..."
   sleep 60; waited=$((waited + 60))
 done
 if [ ! -f "${CORPUS}.meta.json" ]; then

@@ -19,11 +19,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: makes repeated test runs much faster on the
-# slow sandbox CPU (compile once, reuse across pytest invocations).
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Persistent compilation cache: OFF by default.  On this jaxlib (0.4.37,
+# CPU backend) executables deserialized from the persistent cache corrupt
+# the heap when combined with donate_argnums — runs that resume a second
+# Trainer in the same process die with "double free or corruption" / NaN
+# garbage in restored state (reproducible with any cache settings; clean
+# with the cache disabled).  Opt back in on a fixed jaxlib with
+# RELORA_TPU_TEST_COMPILE_CACHE=1.
+if os.environ.get("RELORA_TPU_TEST_COMPILE_CACHE", "0") == "1":
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+else:
+    # The in-process benches (utils/benchlib.py) call enable_compile_cache(),
+    # which would re-enable the persistent cache mid-suite and corrupt later
+    # donate_argnums programs the same way; default its env knob off here.
+    # Tests that exercise the knob monkeypatch the env var explicitly.
+    os.environ.setdefault("RELORA_TPU_COMPILE_CACHE", "0")
 
 import pytest  # noqa: E402
 

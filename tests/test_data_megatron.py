@@ -568,19 +568,33 @@ def test_interleaved_host_slicing(tmp_path):
 
 
 def test_reference_production_yaml_loads():
-    """Drop-in config compatibility: the reference's actual 1B production
-    recipe file parses and finalizes (paths aside)."""
+    """Drop-in config compatibility: the 1B production recipe file (the
+    repo's copy of the reference's training_configs/1B_v1.0.yaml, or the
+    reference checkout itself when present) parses and finalizes."""
     from relora_tpu.config.training import TrainingConfig
 
-    cfg = TrainingConfig.from_yaml("/root/reference/training_configs/1B_v1.0.yaml")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    recipe = "/root/reference/training_configs/1B_v1.0.yaml"
+    data_yaml = "/root/reference/configs/pile_megatron_dataset.yaml"
+    if not os.path.exists(recipe):
+        recipe = os.path.join(repo, "training_configs", "1B_v1.0.yaml")
+        data_yaml = os.path.join(repo, "configs", "pile_megatron_dataset.yaml")
+
+    cwd = os.getcwd()
+    os.chdir(repo)  # the recipe names its dataset yaml repo-relative
+    try:
+        cfg = TrainingConfig.from_yaml(recipe)
+    finally:
+        os.chdir(cwd)
     assert cfg.use_peft and cfg.relora == 1000
     assert cfg.optimizer_reset_mode == "magnitude" and cfg.optimizer_reset_ratio == 0.8
     assert cfg.lr == 4e-4 and cfg.total_batch_size == 1024
     assert cfg.scheduler == "cosine_restarts" and cfg.num_training_steps == 130_000
-    # and the reference's megatron yaml parses through our slim config
-    mcfg = MegatronDataConfig.from_yaml("/root/reference/configs/pile_megatron_dataset.yaml")
+    # and the reference-format megatron yaml parses through our slim config
+    mcfg = MegatronDataConfig.from_yaml(data_yaml)
     assert mcfg.seq_length == 2048 and mcfg.data_impl == "mmap"
-    assert mcfg.train_data_paths == ["/fsx/pile/pile_20B_tokenizer_text_document"]
+    assert len(mcfg.train_data_paths) == 1
+    assert mcfg.train_data_paths[0].endswith("pile_20B_tokenizer_text_document")
 
 
 def test_label_dataset_alignment(tmp_path):

@@ -1,0 +1,415 @@
+"""Fault-tolerance subsystem: preemption-safe checkpointing, save retries
+with integrity fallback, and automatic loss-spike rollback.
+
+Every failure mode here is injected through relora_tpu.utils.faults, so the
+recovery paths run deterministically under tier-1 instead of being
+discovered in production.  The acceptance tests mirror the operational
+drills in docs/operations.md: SIGTERM mid-run -> emergency checkpoint ->
+bit-exact resume, and a poisoned-data loss spike -> rollback + automatic
+skip_batches extension -> run completes without manual intervention.
+"""
+
+import json
+import math
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.config.training import TrainingConfig
+from relora_tpu.train import checkpoint as ckpt
+from relora_tpu.train.resilience import LossSpikeDetector, PreemptionGuard
+from relora_tpu.utils import faults
+
+TINY = ModelConfig(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=2,
+    max_sequence_length=32,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# LossSpikeDetector
+
+
+def feed(det, losses, start=1):
+    events = []
+    for i, loss in enumerate(losses):
+        ev = det.update(start + i, loss)
+        if ev is not None:
+            events.append(ev)
+    return events
+
+
+def test_detector_flags_sustained_spike():
+    det = LossSpikeDetector(threshold=4.0, min_history=8, patience=3)
+    base = [2.0 + 0.01 * ((i * 7) % 5) for i in range(20)]
+    events = feed(det, base + [9.0, 9.5, 9.2])
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.first_step == 21 and ev.last_step == 23
+    assert ev.loss == 9.2
+    assert 1.9 < ev.median < 2.1
+
+
+def test_detector_tolerates_single_blip_and_keeps_baseline_clean():
+    det = LossSpikeDetector(threshold=4.0, min_history=8, patience=3)
+    base = [2.0 + 0.01 * (i % 4) for i in range(16)]
+    # isolated outliers never reach patience; they also must not enter the
+    # window and drag the median up
+    assert feed(det, base + [9.0, 2.0, 9.0, 2.01, 9.0, 2.02]) == []
+    assert det.last_median < 2.2
+
+
+def test_detector_nan_counts_as_outlier():
+    det = LossSpikeDetector(threshold=4.0, min_history=4, patience=2)
+    events = feed(det, [2.0, 2.01, 2.0, 2.02, 2.0, float("nan"), float("inf")])
+    assert len(events) == 1
+    assert events[0].first_step == 6 and events[0].last_step == 7
+    assert not math.isfinite(events[0].loss)
+
+
+def test_detector_reset_streak_keeps_window():
+    det = LossSpikeDetector(threshold=4.0, min_history=4, patience=2)
+    feed(det, [2.0, 2.01, 2.0, 2.02, 2.0])
+    assert det.update(6, 9.0) is None  # streak 1
+    det.reset_streak()
+    assert det.update(7, 9.0) is None  # streak restarts at 1, not 2
+    assert det.last_median < 2.1  # baseline survived the reset
+
+
+def test_detector_validation():
+    with pytest.raises(ValueError):
+        LossSpikeDetector(threshold=0.0)
+    with pytest.raises(ValueError):
+        LossSpikeDetector(threshold=1.0, patience=0)
+    with pytest.raises(ValueError):
+        LossSpikeDetector(threshold=1.0, min_history=2)
+
+
+def test_training_config_validates_spike_fields(tmp_path):
+    kw = dict(dataset_path="/synthetic", save_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        TrainingConfig(**kw, spike_threshold=-1.0).finalize()
+    with pytest.raises(ValueError):
+        TrainingConfig(**kw, spike_threshold=3.0, spike_min_history=2).finalize()
+    with pytest.raises(ValueError):
+        TrainingConfig(**kw, save_retries=-1).finalize()
+
+
+# ---------------------------------------------------------------------------
+# PreemptionGuard
+
+
+def test_preemption_guard_flags_sigterm_and_restores_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)  # let the interpreter run the Python-level handler
+        assert guard.requested and guard.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_preemption_guard_second_sigint_escalates():
+    with PreemptionGuard() as guard:
+        os.kill(os.getpid(), signal.SIGINT)
+        time.sleep(0.05)
+        assert guard.requested
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+            time.sleep(0.5)
+
+
+def test_preemption_guard_disabled_is_inert():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=False):
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# ---------------------------------------------------------------------------
+# faults harness
+
+
+@pytest.mark.faults
+def test_faults_env_parsing():
+    faults.configure_from_env("ckpt_save:times=2;loss:steps=3-5,delta=1.5;preempt:at=7")
+    assert faults.active("ckpt_save") and faults.active("preempt")
+    assert faults.perturb("loss", 1.0, step=4) == 2.5
+    assert faults.perturb("loss", 1.0, step=6) == 1.0
+    faults.configure("nan_grads", steps=[9, 2])
+    assert faults.nan_grad_steps() == (2, 9)
+
+
+@pytest.mark.faults
+def test_faults_maybe_fail_counts_down():
+    faults.configure("ckpt_save", times=2)
+    for _ in range(2):
+        with pytest.raises(OSError):
+            faults.maybe_fail("ckpt_save")
+    faults.maybe_fail("ckpt_save")  # third call passes
+    assert faults.fire_count("ckpt_save") == 2
+
+
+# ---------------------------------------------------------------------------
+# save retries + integrity fallback (checkpoint layer)
+
+
+def _make_state(devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from relora_tpu.parallel.mesh import MeshSpec, make_mesh
+    from relora_tpu.train.state import TrainState
+
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    sharding = NamedSharding(mesh, P("fsdp", None))
+    params = {
+        "layer": {
+            "kernel": jax.device_put(
+                jax.numpy.arange(64.0, dtype=jax.numpy.float32).reshape(8, 8),
+                sharding,
+            ),
+            "bias": jax.numpy.ones((8,), jax.numpy.float32),
+        }
+    }
+    opt_state = {"mu": jax.tree_util.tree_map(jax.numpy.zeros_like, params)}
+    return TrainState.create(params, opt_state)
+
+
+@pytest.mark.faults
+def test_save_retry_recovers_from_transient_io_error(tmp_path, devices):
+    state = _make_state(devices)
+    faults.configure("ckpt_save", times=2)
+    path = ckpt.save_checkpoint(
+        str(tmp_path), 4, state, {"update_step": 4}, retries=3, retry_backoff=0.01
+    )
+    ckpt.wait_for_save()
+    assert faults.fire_count("ckpt_save") == 2  # failed twice, then stuck
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert ok, reason
+    ts, found = ckpt.get_last_checkpoint(str(tmp_path))
+    assert found == path and ts["update_step"] == 4
+
+
+@pytest.mark.faults
+def test_save_retries_exhausted_falls_back_to_previous(tmp_path, devices):
+    state = _make_state(devices)
+    ckpt.save_checkpoint(str(tmp_path), 3, state, {"update_step": 3})
+    ckpt.wait_for_save()
+
+    faults.configure("ckpt_save", times=10)
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint(
+            str(tmp_path), 6, state, {"update_step": 6}, retries=1, retry_backoff=0.01
+        )
+    faults.reset()
+    # the failed save never becomes a resume candidate
+    ts, path = ckpt.get_last_checkpoint(str(tmp_path))
+    assert ts["update_step"] == 3 and path.endswith("model_3")
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level acceptance drills (real training on the tiny model)
+
+
+class FakeTokens:
+    def __init__(self, n=512, seq=16, vocab=128, seed=0):
+        rs = np.random.RandomState(seed)
+        rows = []
+        for _ in range(n):
+            start = rs.randint(vocab)
+            rows.append([(start + j) % vocab for j in range(seq)])
+        self.arr = np.asarray(rows, dtype=np.int32)
+
+    def __len__(self):
+        return len(self.arr)
+
+    def __getitem__(self, idx):
+        return {"input_ids": self.arr[idx]}
+
+
+def make_cfg(tmp_path, **kw):
+    base = dict(
+        dataset_path="/synthetic",
+        batch_size=4,
+        total_batch_size=8,
+        max_length=16,
+        lr=5e-3,
+        scheduler="cosine_restarts",
+        warmup_steps=2,
+        restart_warmup_steps=2,
+        num_training_steps=16,
+        cycle_length=8,
+        relora=8,
+        use_peft=True,
+        lora_r=4,
+        save_dir=str(tmp_path / "ckpt"),
+        save_every=8,
+        eval_every=100,
+        seed=0,
+        dp_size=2,
+    )
+    base.update(kw)
+    return TrainingConfig(**base).finalize()
+
+
+def make_train_factory(cfg, trainer, data):
+    from relora_tpu.data.hf_pipeline import TokenBatchIterator
+
+    def train_factory():
+        return iter(
+            TokenBatchIterator(
+                data,
+                microbatch=cfg.batch_size * trainer.n_batch_shards,
+                grad_accum=trainer.grad_accum,
+                skip_updates=trainer.update_step,
+            )
+        )
+
+    return train_factory
+
+
+def read_events(save_dir):
+    events = []
+    with open(os.path.join(save_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "_event" in rec:
+                events.append(rec)
+    return events
+
+
+@pytest.mark.faults
+def test_sigterm_emergency_checkpoint_and_bitexact_resume(tmp_path):
+    """SIGTERM mid-loop commits an emergency checkpoint; a resumed run
+    continues with bit-exact counters (incl. the NaN-skip counter) and
+    reaches bit-exact final params vs an uninterrupted run."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=1024)
+
+    # reference: uninterrupted 16 steps with one injected NaN-grad update
+    # (nan_abort_fraction raised: 1 skip of 16 would trip the 5% abort)
+    faults.configure("nan_grads", steps=[2])
+    cfg_a = make_cfg(tmp_path / "a", save_every=100, nan_abort_fraction=0.5)
+    tr_a = Trainer(cfg_a, model_cfg=TINY)
+    res_a = tr_a.fit(make_train_factory(cfg_a, tr_a, data)(), None)
+    assert res_a["n_skipped"] == 1 and not res_a["preempted"]
+
+    # interrupted run: a real SIGTERM delivered at the update-5 boundary
+    faults.reset()
+    faults.configure("nan_grads", steps=[2])
+    faults.configure("preempt", at=5)
+    cfg_b = make_cfg(tmp_path / "b", save_every=100, nan_abort_fraction=0.5)
+    tr_b1 = Trainer(cfg_b, model_cfg=TINY)
+    res_b1 = tr_b1.fit(make_train_factory(cfg_b, tr_b1, data)(), None)
+    assert res_b1["preempted"] is True
+    stop = res_b1["update_step"]
+    # signal delivery lands at the armed boundary or (rarely) one later
+    assert 5 <= stop <= 6
+
+    emergency = os.path.join(cfg_b.save_dir, f"model_{stop}")
+    assert os.path.isdir(os.path.join(emergency, ckpt.STATE_SUBDIR))
+    ok, reason = ckpt.verify_checkpoint(emergency, check_arrays=True)
+    assert ok, reason
+    kinds = [e["_event"] for e in read_events(cfg_b.save_dir)]
+    assert "preemption" in kinds and "emergency_checkpoint" in kinds
+
+    # resume: counters restore bit-exact, run finishes identically to A
+    faults.reset()
+    faults.configure("nan_grads", steps=[2])  # same compiled step as A/B1
+    cfg_b2 = make_cfg(
+        tmp_path / "b", save_every=100, autoresume=True, nan_abort_fraction=0.5
+    )
+    tr_b2 = Trainer(cfg_b2, model_cfg=TINY)
+    assert tr_b2.update_step == stop
+    assert int(tr_b2.state.n_skipped) == 1  # NaN counter survived
+    assert tr_b2.tokens_seen == stop * cfg_b.total_batch_size * 16
+    res_b2 = tr_b2.fit(make_train_factory(cfg_b2, tr_b2, data)(), None)
+
+    assert res_b2["update_step"] == res_a["update_step"] == 16
+    assert res_b2["tokens_seen"] == res_a["tokens_seen"]
+    assert res_b2["n_skipped"] == res_a["n_skipped"]
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(tr_a.state.params),
+        jax.tree_util.tree_leaves(tr_b2.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.faults
+def test_loss_spike_rolls_back_and_auto_extends_skip(tmp_path):
+    """An injected loss spike triggers automatic rollback to the last good
+    checkpoint and auto-extends skip_batches over the poisoned window; the
+    run then completes WITHOUT any manual skip_batches."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=1024)
+    faults.configure("loss", steps=range(9, 12), delta=8.0)
+    cfg = make_cfg(
+        tmp_path,
+        num_training_steps=16,
+        save_every=4,
+        relora=None,
+        use_peft=False,
+        scheduler="cosine",
+        cycle_length=16,
+        spike_threshold=4.0,
+        spike_window=8,
+        spike_min_history=4,
+        spike_patience=3,
+    )
+    trainer = Trainer(cfg, model_cfg=TINY)
+    factory = make_train_factory(cfg, trainer, data)
+    result = trainer.fit(factory(), None, train_iter_factory=factory)
+
+    assert result["update_step"] == 16 and not result["aborted"]
+    assert result["n_rollbacks"] == 1
+    # logged window [9, 11] maps to pre-increment skip indices 8..11(+margin)
+    assert {8, 9, 10, 11} <= cfg.skip_batches
+
+    events = read_events(cfg.save_dir)
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["_event"], []).append(e)
+    assert by_kind["loss_spike"][0]["first_step"] == 9
+    assert by_kind["loss_spike"][0]["last_step"] == 11
+    assert by_kind["rollback"][0]["target"].endswith("model_8")
+    skipped_at = [e["_step"] for e in by_kind["batch_skipped"]]
+    assert skipped_at == [8, 9, 10, 11]
+
+    # recovery state survives a process restart: the final checkpoint records
+    # the blacklist and the rollback count
+    with open(os.path.join(cfg.save_dir, "model_16", ckpt.TRAINING_STATE_FILE)) as f:
+        ts = json.load(f)
+    assert ts["n_spike_rollbacks"] == 1
+    assert set(ts["skip_batches"]) >= {8, 9, 10, 11}
+
+
+def test_resume_with_changed_batch_size_rejected(tmp_path):
+    """The data rewind assumes a fixed batch size; resuming with a different
+    one must fail loudly instead of silently de-aligning the stream."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=512)
+    cfg = make_cfg(tmp_path, num_training_steps=8, save_every=8)
+    trainer = Trainer(cfg, model_cfg=TINY)
+    trainer.fit(make_train_factory(cfg, trainer, data)(), None)
+
+    cfg2 = make_cfg(tmp_path, num_training_steps=16, batch_size=2, autoresume=True)
+    with pytest.raises(RuntimeError, match="batch size"):
+        Trainer(cfg2, model_cfg=TINY)

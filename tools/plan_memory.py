@@ -161,25 +161,31 @@ def plan(
     bytes_el = 2 if dtype == "bf16" else 4
     tok = (B / batch_div) * (S / seq_div)
     heads = cfg.num_attention_heads / mesh_factors.get("tensor", 1)
+    # Per-layer dot outputs saved by the 'dots' family of remat policies:
+    # hidden-width — q, k, v, attn out-proj, mlp down-proj (its OUTPUT is
+    # H-wide even though its input is inter-wide) plus the layer-boundary
+    # residual = 6×H; inter-width — mlp gate and up projections = 2×inter.
+    # 'dots_narrow' recomputes exactly those 2 inter-width dots
+    # (params_util.remat_policy 'dots_narrow'), so both policies must share
+    # one inter count for the predicted dots→dots_narrow saving
+    # (2 × inter × tok × bytes_el per layer) to match the policy's true
+    # delta.  (Earlier accounting charged dots 3×inter / dots_narrow 5×H,
+    # which overstated the saving by inter−H per token per layer.)
+    n_hidden_dots, n_inter_dots = 6, 2
     if remat == "full":
         act = L * tok * H * bytes_el  # layer-boundary residual per layer
     elif remat == "dots":
-        # boundaries + saved matmul outputs (qkv, attn out, 3 mlp)
         inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
-        per_layer = tok * (H * 5 + inter * 3) * bytes_el
+        per_layer = tok * (H * n_hidden_dots + inter * n_inter_dots) * bytes_el
         act = L * per_layer
     elif remat == "dots_narrow":
-        # boundaries + hidden-width matmul outputs only: the intermediate-
-        # width gate/up outputs are recomputed (params_util.remat_policy
-        # 'dots_narrow'), eliminating the inter-width residual term that
-        # dominates 'dots' memory at wide-MLP models
-        per_layer = tok * (H * 5) * bytes_el
+        per_layer = tok * (H * n_hidden_dots) * bytes_el
         act = L * per_layer
     elif remat == "dots_all":
         # dots_saveable additionally keeps the S^2-per-head attention
         # logits as residuals, in COMPUTE dtype (params_util.remat_policy)
         inter = cfg.intermediate_size / mesh_factors.get("tensor", 1)
-        per_layer = tok * (H * 5 + inter * 3) * bytes_el + (
+        per_layer = tok * (H * n_hidden_dots + inter * n_inter_dots) * bytes_el + (
             (B / batch_div) * heads * (S / seq_div) * S * bytes_el
         )
         act = L * per_layer
