@@ -24,6 +24,14 @@ the probe.
 Other BASELINE.md benchmark configs are selectable by env var, e.g.
 ``BENCH_CONFIG=llama_250m python bench.py``.  The measurement loop itself
 lives in relora_tpu.utils.benchlib (shared with scripts/bench_sweep.py).
+
+``--mode decode`` benchmarks the inference engine instead (relora_tpu/serve):
+prefill tokens/sec, steady-state decode tokens/sec, and p50/p95 per-token
+latency, written to ``BENCH_serve.json`` and printed as one JSON line.
+Configured by env: BENCH_SERVE_MODEL (default llama_250m), BENCH_SERVE_BATCH,
+BENCH_SERVE_PROMPT_LEN, BENCH_SERVE_NEW_TOKENS.  Runs on whatever backend is
+up — CPU included — so it carries no probe/stale-fallback machinery; the
+device lands in the artifact for the reader to judge.
 """
 
 from __future__ import annotations
@@ -202,7 +210,91 @@ def main() -> None:
             pass
 
 
+def decode_main() -> None:
+    """--mode decode: benchmark the serve engine's prefill and decode steps."""
+    import time
+
+    model_name = os.environ.get("BENCH_SERVE_MODEL", "llama_250m")
+    batch = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_SERVE_PROMPT_LEN", "128"))
+    new_tokens = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "64"))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from relora_tpu.config.model import load_model_config
+    from relora_tpu.models.params_util import init_params
+    from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+
+    cfg = load_model_config(model_name)
+    cache_size = prompt_len + new_tokens + 8
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    model = build_decode_model(cfg, cache_size=cache_size, dtype=dtype)
+    params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    engine = InferenceEngine(cfg, params, cache_size=cache_size, dtype=dtype)
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    # warm the prefill compile, then time one prefill
+    logits, _ = engine.prefill(prompt)
+    jax.block_until_ready(logits)
+    t0 = time.perf_counter()
+    logits, cache = engine.prefill(prompt)
+    jax.block_until_ready(logits)
+    prefill_s = time.perf_counter() - t0
+
+    token = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+    pos = jnp.full((batch, 1), prompt_len, jnp.int32)
+    # warm the decode compile (first step, excluded from the timings)
+    step_logits, cache = engine.decode(cache, token, pos)
+    jax.block_until_ready(step_logits)
+    token = jnp.argmax(step_logits, axis=-1)[:, None]
+    pos = pos + 1
+    latencies = []
+    for _ in range(new_tokens):
+        t0 = time.perf_counter()
+        step_logits, cache = engine.decode(cache, token, pos)
+        jax.block_until_ready(step_logits)
+        latencies.append(time.perf_counter() - t0)
+        token = jnp.argmax(step_logits, axis=-1)[:, None]
+        pos = pos + 1
+
+    lat = np.asarray(latencies)
+    result = {
+        "metric": f"{model_name} serve decode throughput",
+        "value": round(batch * len(lat) / float(lat.sum()), 2),
+        "unit": "tokens/sec",
+        "detail": {
+            "model": model_name,
+            "device": str(jax.devices()[0]),
+            "dtype": "bf16" if on_tpu else "f32",
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "prefill_tokens_per_sec": round(batch * prompt_len / prefill_s, 2),
+            "decode_tokens_per_sec": round(batch * len(lat) / float(lat.sum()), 2),
+            "per_token_latency_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "per_token_latency_p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_serve.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
+    import argparse
+
+    _ap = argparse.ArgumentParser()
+    _ap.add_argument("--mode", choices=["train", "decode"], default="train")
+    _cli = _ap.parse_args()
+    if _cli.mode == "decode":
+        decode_main()
+        sys.exit(0)
     if os.environ.get("BENCH_FORCE") != "1":
         platform, err = _probe_device()
         if not platform:

@@ -275,7 +275,10 @@ def merged_params(params: PyTree, spec: LoraSpec) -> PyTree:
     def walk(node):
         if not isinstance(node, dict):
             return node
-        if LORA_A not in node:
+        if LORA_A not in node or LORA_B not in node:
+            # no factors (already-merged / full-rank tree — e.g. a serve-side
+            # load of an exported checkpoint whose relora_config.json sidecar
+            # survived the merge): pass through instead of KeyError-ing
             return {k: walk(v) for k, v in node.items()}
         from relora_tpu.ops.quant import NF4_MODULE_LEAVES
 

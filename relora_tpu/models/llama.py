@@ -30,7 +30,42 @@ from flax import linen as nn
 from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
 from relora_tpu.models.lora import LoRALinear
-from relora_tpu.ops.attention import dot_product_attention
+from relora_tpu.ops.attention import cached_attention, dot_product_attention
+
+
+def attend_with_cache(
+    module: nn.Module,
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Append this call's K/V into the module's fixed-capacity cache
+    variables ("cache" collection, shape (B, cache_size, n_kv, head_dim))
+    and attend against the full cache with the position mask.
+
+    Shared by both attention families (llama.LlamaAttention,
+    pythia.NeoXAttention).  ``positions`` (B|1, T) must be contiguous along
+    T — the write is a per-row dynamic_update_slice starting at
+    ``positions[:, 0]`` (prefill: 0..S-1; decode: T=1 at the slot's length).
+    Under ``nn.scan`` the cache variables stack on the leading "layers"
+    axis, exactly like the params.
+    """
+    B, T = q.shape[:2]
+    capacity = module.cache_size
+    if capacity < 1:
+        raise ValueError("decode=True requires cache_size >= 1")
+    n_kv, hd = k_new.shape[2], k_new.shape[3]
+    ck = module.variable("cache", "k", jnp.zeros, (B, capacity, n_kv, hd), k_new.dtype)
+    cv = module.variable("cache", "v", jnp.zeros, (B, capacity, n_kv, hd), v_new.dtype)
+    positions = jnp.broadcast_to(positions, (B, T)).astype(jnp.int32)
+
+    def write(cache, new, start):
+        return jax.lax.dynamic_update_slice(cache, new, (start, 0, 0))
+
+    ck.value = jax.vmap(write)(ck.value, k_new.astype(ck.value.dtype), positions[:, 0])
+    cv.value = jax.vmap(write)(cv.value, v_new.astype(cv.value.dtype), positions[:, 0])
+    return cached_attention(q, ck.value, cv.value, positions)
 
 
 class RMSNorm(nn.Module):
@@ -107,6 +142,11 @@ class LlamaAttention(nn.Module):
     lora: Optional[LoraSpec] = None
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
+    # decode=True switches to the KV-cached inference forward: K/V of the
+    # tokens in this call are appended into fixed-capacity cache variables
+    # at ``positions`` and attention runs masked against the whole cache.
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
     def __call__(
@@ -114,6 +154,7 @@ class LlamaAttention(nn.Module):
         x: jax.Array,
         cos: jax.Array,
         sin: jax.Array,
+        positions: Optional[jax.Array] = None,
         deterministic: bool = True,
     ) -> jax.Array:
         cfg = self.config
@@ -135,7 +176,10 @@ class LlamaAttention(nn.Module):
         # grouped-query attention: K/V keep their n_kv heads all the way into
         # the attention impls (no jnp.repeat — the repeat would materialize
         # n/n_kv× the K/V bytes in HBM and ride the ring at full width)
-        out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
+        if self.decode:
+            out = attend_with_cache(self, q, k, v, positions)
+        else:
+            out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
         out = out.reshape(B, S, h)
         return dense(h, kernel_axes=("qkv", "embed"), name="o_proj")(out, deterministic)
 
@@ -162,21 +206,24 @@ class LlamaMLP(nn.Module):
 class LlamaDecoderLayer(nn.Module):
     """Pre-norm block (parity: modeling_llama.py:243-308).
 
-    Signature is scan-compatible: ``(x, cos, sin, det) -> (x, None)``.
+    Signature is scan-compatible: ``(x, cos, sin, positions, det) -> (x, None)``.
     """
 
     config: ModelConfig
     lora: Optional[LoraSpec] = None
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
-    def __call__(self, x, cos, sin, deterministic: bool = True):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True):
         cfg = self.config
         a = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         a = LlamaAttention(
-            cfg, self.lora, self.dtype, self.attention_impl, name="self_attn"
-        )(a, cos, sin, deterministic)
+            cfg, self.lora, self.dtype, self.attention_impl,
+            self.decode, self.cache_size, name="self_attn"
+        )(a, cos, sin, positions, deterministic)
         x = x + a
         m = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="post_attention_layernorm")(x)
         m = LlamaMLP(cfg, self.lora, self.dtype, name="mlp")(m, deterministic)
@@ -207,6 +254,7 @@ def decoder_stack(
         current_length=input_len,
     )
 
+    decode = getattr(module, "decode", False)
     block = LlamaDecoderLayer
     if module.remat:
         from relora_tpu.models.params_util import remat_policy
@@ -214,7 +262,7 @@ def decoder_stack(
         block = nn.remat(
             block,
             prevent_cse=not module.scan_layers,
-            static_argnums=(4,),  # deterministic
+            static_argnums=(5,),  # deterministic
             policy=remat_policy(
                 getattr(module, "remat_policy", "full"),
                 max_save_width=cfg.hidden_size,
@@ -225,20 +273,26 @@ def decoder_stack(
         lora=module.lora,
         dtype=module.dtype,
         attention_impl=module.attention_impl,
+        decode=decode,
+        cache_size=getattr(module, "cache_size", 0),
     )
     if module.scan_layers:
+        variable_axes = {"params": 0}
+        if decode:
+            # per-layer KV cache stacks on the same leading "layers" axis
+            variable_axes["cache"] = 0
         scanned = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes=variable_axes,
             split_rngs={"params": True, "dropout": True},
-            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, deterministic)
+        x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, positions, deterministic)
     else:
         for i in range(cfg.num_hidden_layers):
-            x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, deterministic)
+            x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, positions, deterministic)
     return RMSNorm(eps=cfg.rms_norm_eps, dtype=module.dtype, name="norm")(x)
 
 
@@ -275,6 +329,10 @@ class LlamaForCausalLM(nn.Module):
     # f32 logits are the safe default; bf16 halves the (B, S, vocab) HBM
     # footprint — the loss upcasts to f32 either way
     logits_dtype: jnp.dtype = jnp.float32
+    # inference: decode=True turns on the per-layer KV caches ("cache"
+    # variable collection) of capacity cache_size (see serve/engine.py)
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
     def __call__(

@@ -26,7 +26,7 @@ from flax import linen as nn
 
 from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
-from relora_tpu.models.llama import apply_rotary, rotary_tables
+from relora_tpu.models.llama import apply_rotary, attend_with_cache, rotary_tables
 from relora_tpu.models.lora import LoRALinear
 from relora_tpu.ops.attention import dot_product_attention
 
@@ -63,9 +63,11 @@ class NeoXAttention(nn.Module):
     lora: Optional[LoraSpec] = None
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
-    def __call__(self, x, cos, sin, deterministic: bool = True):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True):
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
         rot = cfg.rotary_dim
@@ -88,7 +90,10 @@ class NeoXAttention(nn.Module):
         q = jnp.concatenate([apply_rotary(q[..., :rot], cos, sin), q[..., rot:]], axis=-1)
         k = jnp.concatenate([apply_rotary(k[..., :rot], cos, sin), k[..., rot:]], axis=-1)
 
-        out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
+        if self.decode:
+            out = attend_with_cache(self, q, k, v, positions)
+        else:
+            out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
         out = out.reshape(B, S, h)
         return LoRALinear(
             h,
@@ -128,14 +133,17 @@ class NeoXLayer(nn.Module):
     lora: Optional[LoraSpec] = None
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
-    def __call__(self, x, cos, sin, deterministic: bool = True):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True):
         cfg = self.config
         attn_in = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         attn_out = NeoXAttention(
-            cfg, self.lora, self.dtype, self.attention_impl, name="attention"
-        )(attn_in, cos, sin, deterministic)
+            cfg, self.lora, self.dtype, self.attention_impl,
+            self.decode, self.cache_size, name="attention"
+        )(attn_in, cos, sin, positions, deterministic)
         mlp_in = LayerNorm(
             eps=cfg.layer_norm_eps, dtype=self.dtype, name="post_attention_layernorm"
         )(x if cfg.use_parallel_residual else x + attn_out)
@@ -157,6 +165,10 @@ class GPTNeoXForCausalLM(nn.Module):
     remat_policy: str = "full"  # 'full' | 'dots' (see params_util.remat_policy)
     attention_impl: str = "auto"
     logits_dtype: jnp.dtype = jnp.float32
+    # inference: decode=True turns on the per-layer KV caches ("cache"
+    # variable collection) of capacity cache_size (see serve/engine.py)
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
     def __call__(
@@ -197,27 +209,33 @@ class GPTNeoXForCausalLM(nn.Module):
             block = nn.remat(
                 block,
                 prevent_cse=not self.scan_layers,
-                static_argnums=(4,),
+                static_argnums=(5,),
                 policy=remat_policy(
                     self.remat_policy, max_save_width=self.config.hidden_size
                 ),
             )
         layer_kwargs = dict(
-            config=cfg, lora=self.lora, dtype=self.dtype, attention_impl=self.attention_impl
+            config=cfg, lora=self.lora, dtype=self.dtype,
+            attention_impl=self.attention_impl, decode=self.decode,
+            cache_size=self.cache_size,
         )
         if self.scan_layers:
+            variable_axes = {"params": 0}
+            if self.decode:
+                # per-layer KV cache stacks on the same leading "layers" axis
+                variable_axes["cache"] = 0
             scanned = nn.scan(
                 block,
-                variable_axes={"params": 0},
+                variable_axes=variable_axes,
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, deterministic)
+            x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, positions, deterministic)
         else:
             for i in range(cfg.num_hidden_layers):
-                x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, deterministic)
+                x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, positions, deterministic)
 
         x = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)
         if return_hidden:

@@ -134,6 +134,47 @@ def _pallas_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     return _grouped_equal_heads_call(q, k, v, equal_heads)
 
 
+def cached_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Masked decode attention against a fixed-capacity KV cache.
+
+    ``q`` is ``(B, T, N, H)`` — T is 1 for single-token decode, up to S for
+    prefill — holding queries at absolute positions ``positions`` ``(B, T)``
+    (or ``(1, T)``, broadcast over batch).  ``k``/``v`` are the cache buffers
+    ``(B, C, N_kv, H)`` with capacity C; entry ``j`` of the cache is visible
+    to the query at position ``p`` iff ``j <= p``, which is simultaneously
+    the causal mask (prefill), the length mask that hides not-yet-written
+    (or stale, from an evicted slot) cache tail entries (decode), and the
+    pad mask for right-padded prompts.
+
+    Math in f32 like the ``naive`` oracle: decode is memory-bound — the
+    arithmetic is negligible next to streaming the cache from HBM — so
+    there is no reason to give up softmax accuracy.  Grouped-query K/V
+    attends without materializing the head expansion.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    B, T, N, H = q.shape
+    C, n_kv = k.shape[1], k.shape[2]
+    if N % n_kv:
+        raise ValueError(f"num_heads={N} must divide by kv_heads={n_kv}")
+    qg = q.astype(jnp.float32).reshape(B, T, n_kv, N // n_kv, H)
+    logits = jnp.einsum("btkgh,bskh->bkgts", qg, k.astype(jnp.float32)) * scale
+    visible = jnp.arange(C)[None, None, :] <= positions[..., None]  # (B|1, T, C)
+    logits = jnp.where(
+        visible[:, None, None, :, :], logits, jnp.finfo(jnp.float32).min
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, N, H).astype(q.dtype)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

@@ -1,0 +1,24 @@
+"""Inference: KV-cache decode, sampling, and continuous batching.
+
+The serving counterpart of ``relora_tpu.train``: every ReLoRA checkpoint
+merges into a plain full-rank model (core/relora.merged_params), and this
+package runs it — ``engine.InferenceEngine`` for the jitted prefill/decode
+steps, ``sampling`` for jittable token selection, ``scheduler`` for the
+slot-based continuous-batching loop.  The ``serve.py`` CLI at the repo root
+ties them to checkpoint loading.
+"""
+
+from relora_tpu.serve.engine import InferenceEngine, build_decode_model, bucket_length
+from relora_tpu.serve.sampling import SamplingParams, sample
+from relora_tpu.serve.scheduler import Completion, ContinuousBatchingScheduler, Request
+
+__all__ = [
+    "Completion",
+    "ContinuousBatchingScheduler",
+    "InferenceEngine",
+    "Request",
+    "SamplingParams",
+    "bucket_length",
+    "build_decode_model",
+    "sample",
+]

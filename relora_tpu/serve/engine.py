@@ -1,0 +1,294 @@
+"""Jitted prefill/decode step functions over the cache-aware model forwards.
+
+The models gained a ``decode=True`` mode (models/llama.py, models/pythia.py):
+attention keeps per-layer K/V buffers of fixed capacity in the flax ``cache``
+variable collection, writes the current chunk at its absolute positions, and
+attends with the ``j <= position`` visibility mask (ops/attention.py:
+cached_attention).  This module wraps that into an inference engine:
+
+- ``prefill(ids, lengths)`` — run the whole (right-padded) prompt batch in one
+  forward, returning full logits and a populated cache.  Pad tokens write
+  garbage K/V beyond each row's length, but an entry at index ``j`` only
+  becomes visible to queries at positions ``>= j`` — and the decode loop
+  overwrites index ``j`` at the step that reaches position ``j``, before it
+  ever attends.  So right-padding needs no separate pad mask.
+- ``decode(cache, token, pos)`` — one token per row against the cache, cache
+  buffers donated so XLA updates them in place (no per-step reallocation).
+- ``insert(dcache, pcache, slot)`` — copy a freshly prefilled single-row cache
+  into slot ``slot`` of the persistent decode cache (continuous batching
+  admission).  ``slot`` is traced, so admissions never retrace.
+
+Prompt lengths are bucketed to powers of two (``bucket_length``) to bound the
+number of prefill compilations.
+
+Shardings: with a mesh, params shard per the model's logical annotations
+(parallel/mesh.py LOGICAL_RULES) and cache buffers shard their batch axis over
+``data``×``fsdp`` — K/V heads stay replicated like the ``kv`` logical axis.
+Without a mesh the same code runs single-host (CPU tests, dev boxes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, param_shardings
+from relora_tpu.serve.sampling import SamplingParams, sample
+
+PyTree = Any
+
+# leaves are (B, capacity, kv_heads, head_dim), plus a leading scan-layers
+# axis when the model scans; the batch axis is always ndim-4
+_CACHE_RANK = 4
+
+
+def _cache_batch_axis(leaf) -> int:
+    return leaf.ndim - _CACHE_RANK
+
+
+def bucket_length(n: int, minimum: int = 16) -> int:
+    """Round a prompt length up to the next power of two (>= minimum) so
+    prefill compiles once per bucket, not once per prompt length."""
+    if n < 1:
+        raise ValueError(f"prompt length must be >= 1, got {n}")
+    return max(minimum, 1 << (n - 1).bit_length())
+
+
+def build_decode_model(
+    model_cfg: ModelConfig,
+    *,
+    cache_size: int,
+    dtype=jnp.float32,
+    scan_layers: bool = True,
+    attention_impl: str = "auto",
+):
+    """The serving twin of train.trainer.build_model: same family dispatch,
+    LoRA-free (serve loads merged params), decode cache enabled, no remat."""
+    kwargs = dict(
+        config=model_cfg,
+        lora=None,
+        dtype=dtype,
+        scan_layers=scan_layers,
+        remat=False,
+        attention_impl=attention_impl,
+        logits_dtype=jnp.float32,
+        decode=True,
+        cache_size=cache_size,
+    )
+    if model_cfg.family == "llama":
+        from relora_tpu.models.llama import LlamaForCausalLM
+
+        return LlamaForCausalLM(**kwargs)
+    if model_cfg.family == "neox":
+        from relora_tpu.models.pythia import GPTNeoXForCausalLM
+
+        return GPTNeoXForCausalLM(**kwargs)
+    raise ValueError(f"Unknown model family {model_cfg.family!r}")
+
+
+class InferenceEngine:
+    """Owns the decode-mode model, the jitted step functions, and placement.
+
+    ``params`` must be a merged (LoRA-free) tree matching the training layout
+    (scan-stacked layers when ``scan_layers``) — see
+    train.checkpoint.restore_serving_params.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params: PyTree,
+        *,
+        cache_size: int,
+        dtype=jnp.float32,
+        scan_layers: bool = True,
+        attention_impl: str = "auto",
+        mesh: Optional[Mesh] = None,
+    ):
+        if cache_size < 1:
+            raise ValueError(f"cache_size must be >= 1, got {cache_size}")
+        self.config = model_cfg
+        self.cache_size = cache_size
+        self.mesh = mesh
+        self.model = build_decode_model(
+            model_cfg,
+            cache_size=cache_size,
+            dtype=dtype,
+            scan_layers=scan_layers,
+            attention_impl=attention_impl,
+        )
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if mesh is not None:
+            from relora_tpu.models.params_util import logical_partition_specs
+
+            sample_ids = jnp.zeros((1, 1), jnp.int32)
+            specs = logical_partition_specs(self.model, sample_ids)
+            shardings = param_shardings(mesh, specs)
+            params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        self.params = params
+
+        def prefill_fn(p, ids, positions, cache):
+            logits, variables = self.model.apply(
+                {"params": p, "cache": cache}, ids, positions=positions, mutable=["cache"]
+            )
+            return logits, variables["cache"]
+
+        def decode_fn(p, cache, token, pos):
+            logits, variables = self.model.apply(
+                {"params": p, "cache": cache}, token, positions=pos, mutable=["cache"]
+            )
+            return logits[:, -1, :], variables["cache"]
+
+        def insert_fn(dcache, pcache, slot):
+            def ins(d, src):
+                starts = [0] * d.ndim
+                starts[_cache_batch_axis(d)] = slot
+                return jax.lax.dynamic_update_slice(d, src.astype(d.dtype), tuple(starts))
+
+            return jax.tree_util.tree_map(ins, dcache, pcache)
+
+        # the fresh prefill cache and the persistent decode cache are both
+        # donated: the step's output cache reuses the input buffers in place
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(3,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+        self._insert = jax.jit(insert_fn, donate_argnums=(0,))
+        self._sample = jax.jit(sample, static_argnames=("top_k",))
+
+    # -- cache construction --------------------------------------------------
+
+    def cache_shapes(self, batch: int) -> PyTree:
+        """Abstract (shape, dtype) tree of the cache for a given batch size —
+        eval_shape over model.init, so no FLOPs or memory."""
+        ids = jnp.zeros((batch, 1), jnp.int32)
+        variables = jax.eval_shape(
+            lambda: self.model.init(jax.random.PRNGKey(0), ids)
+        )
+        return variables["cache"]
+
+    def cache_shardings(self, batch: int) -> Optional[PyTree]:
+        """Batch axis over data×fsdp, everything else replicated — K/V heads
+        stay unsharded like the ``kv`` logical axis in LOGICAL_RULES."""
+        if self.mesh is None:
+            return None
+
+        def spec(leaf):
+            axes = [None] * leaf.ndim
+            n_shards = (
+                self.mesh.shape[DATA_AXIS] * self.mesh.shape[FSDP_AXIS]
+            )
+            if batch % n_shards == 0:
+                axes[_cache_batch_axis(leaf)] = (DATA_AXIS, FSDP_AXIS)
+            return NamedSharding(self.mesh, P(*axes))
+
+        return jax.tree_util.tree_map(spec, self.cache_shapes(batch))
+
+    def init_cache(self, batch: int) -> PyTree:
+        """Concrete zero cache for ``batch`` rows, placed per the mesh."""
+        shardings = self.cache_shardings(batch)
+        shapes = self.cache_shapes(batch)
+        if shardings is None:
+            return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        return jax.tree_util.tree_map(
+            lambda s, sh: jax.device_put(jnp.zeros(s.shape, s.dtype), sh),
+            shapes,
+            shardings,
+        )
+
+    # -- step functions ------------------------------------------------------
+
+    def prefill(self, ids: jax.Array, lengths=None) -> Tuple[jax.Array, PyTree]:
+        """Run a right-padded prompt batch ``(B, T)``; returns full logits
+        ``(B, T, V)`` and the populated cache.  ``T`` must be <= cache_size
+        (bucket prompts with ``bucket_length`` before calling)."""
+        B, T = ids.shape
+        if T > self.cache_size:
+            raise ValueError(f"prompt length {T} exceeds cache capacity {self.cache_size}")
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+        cache = self.init_cache(B)
+        return self._prefill(self.params, jnp.asarray(ids), positions, cache)
+
+    def decode(self, cache: PyTree, token: jax.Array, pos: jax.Array) -> Tuple[jax.Array, PyTree]:
+        """One decode step: ``token``/``pos`` are ``(B, 1)``; returns logits
+        ``(B, V)`` and the updated cache.  The input cache is donated —
+        the caller must not reuse it after this call."""
+        return self._decode(
+            self.params, cache, jnp.asarray(token), jnp.asarray(pos, jnp.int32)
+        )
+
+    def insert(self, dcache: PyTree, pcache: PyTree, slot) -> PyTree:
+        """Copy a single-row prefilled cache into decode slot ``slot``.
+        ``dcache`` is donated; ``slot`` is traced (no retrace per slot)."""
+        return self._insert(dcache, pcache, jnp.asarray(slot, jnp.int32))
+
+    # -- convenience: one-shot batch generation ------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        max_new_tokens: int,
+        sampling: SamplingParams = SamplingParams(),
+        eos_id: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+    ) -> List[List[int]]:
+        """Batch generation without continuous batching: pad all prompts to one
+        bucket, prefill, then decode until every row hits EOS/max_new_tokens.
+        The scheduler (serve/scheduler.py) is the production path; this is the
+        one-shot ``--prompt`` path and the parity-test oracle."""
+        if not prompts:
+            return []
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        if lengths.min() < 1:
+            raise ValueError("empty prompt")
+        T = min(bucket_length(int(lengths.max())), self.cache_size)
+        if int(lengths.max()) + max_new_tokens > self.cache_size:
+            raise ValueError(
+                f"prompt ({lengths.max()}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds cache capacity {self.cache_size}"
+            )
+        B = len(prompts)
+        ids = np.zeros((B, T), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, : lengths[i]] = np.asarray(p, np.int32)
+
+        logits, cache = self.prefill(jnp.asarray(ids), lengths)
+        last = jnp.take_along_axis(
+            logits, jnp.asarray(lengths - 1)[:, None, None], axis=1
+        )[:, 0, :]
+        token = self._sample(
+            last,
+            jax.random.fold_in(key, 0),
+            temperature=sampling.temperature,
+            top_k=sampling.top_k,
+            top_p=sampling.top_p,
+        )
+        pos = jnp.asarray(lengths, jnp.int32)
+        out: List[List[int]] = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        for step in range(max_new_tokens):
+            host_tok = np.asarray(token)
+            for i in range(B):
+                if not done[i]:
+                    out[i].append(int(host_tok[i]))
+                    if eos_id is not None and host_tok[i] == eos_id:
+                        done[i] = True
+            if done.all() or step == max_new_tokens - 1:
+                break
+            logits, cache = self.decode(cache, token[:, None], pos[:, None])
+            pos = pos + 1
+            token = self._sample(
+                logits,
+                jax.random.fold_in(key, step + 1),
+                temperature=sampling.temperature,
+                top_k=sampling.top_k,
+                top_p=sampling.top_p,
+            )
+        return out

@@ -1,0 +1,93 @@
+"""Jittable token sampling for the decode loop.
+
+One function, ``sample``, covers the standard policies — greedy, temperature,
+top-k, top-p (nucleus) — composed in the usual order: top-k filter, then
+nucleus filter, then temperature-scaled categorical.  Everything traces under
+``jax.jit``:
+
+- ``temperature`` and ``top_p`` may be traced scalars or per-row ``(B,)``
+  arrays (the continuous-batching scheduler mixes requests with different
+  sampling settings in one decode step).  ``temperature <= 0`` selects greedy
+  for that row — computed as a ``where`` over both branches, so the compiled
+  step never retraces when a greedy request shares a batch with sampled ones.
+- ``top_k`` is a static int (it changes the ``lax.top_k`` shape); 0 disables.
+- ``key`` is either one PRNG key shared across the batch, or a stacked
+  ``(B, key_size)`` batch of per-row keys.  Per-row keys make a request's
+  sample stream independent of which other requests happen to share its
+  batch — fold in the request id, not the slot index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = jnp.finfo(jnp.float32).min
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy.  ``temperature=0`` is greedy."""
+
+    temperature: float = 0.0
+    top_k: int = 0  # 0 disables; static (changes compiled shapes)
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+def top_k_mask(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k largest logits per row, -inf the rest.  ``k`` static."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def top_p_mask(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filter: keep the smallest set of tokens whose probability mass
+    reaches ``top_p``, -inf the rest.  A token stays iff the mass *strictly
+    before* it (descending order) is < top_p — so the argmax always survives
+    and the kept set's mass is the smallest one >= top_p."""
+    order = jnp.argsort(logits, axis=-1)[..., ::-1]  # descending
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = mass_before < jnp.asarray(top_p, jnp.float32)[..., None]
+    inverse = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inverse, axis=-1)
+    return jnp.where(keep, logits, _NEG_INF)
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature=0.0,
+    top_k: int = 0,
+    top_p=1.0,
+) -> jax.Array:
+    """Sample next-token ids ``(B,)`` from logits ``(B, V)``.
+
+    ``temperature``/``top_p`` broadcast per-row; rows with ``temperature <= 0``
+    take the argmax.  ``key`` is one key or a ``(B, ...)`` stack of keys.
+    """
+    logits = logits.astype(jnp.float32)
+    B = logits.shape[0]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    filtered = top_k_mask(logits, top_k)
+    filtered = top_p_mask(filtered, jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,)))
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    scaled = filtered / jnp.maximum(temp, 1e-6)[:, None]
+    if key.ndim > 1:  # per-row keys
+        drawn = jax.vmap(jax.random.categorical)(key, scaled)
+    else:
+        drawn = jax.random.categorical(key, scaled)
+    return jnp.where(temp <= 0.0, greedy, drawn)

@@ -325,6 +325,25 @@ def restore_params_host(path: str) -> PyTree:
     return restored
 
 
+def restore_serving_params(path: str) -> PyTree:
+    """Params ready for inference: the checkpoint's param tree with LoRA
+    factors merged into the base kernels when (and only when) they are
+    present.
+
+    Handles all three checkpoint flavors the serve path meets: a full-rank
+    run (no ``relora_config.json``), a live ReLoRA run (factors present —
+    merge via the saved spec), and an exported/already-merged tree that still
+    carries its ``relora_config.json`` sidecar (no ``lora_a`` leaves — the
+    merge walk passes it through unchanged instead of KeyError-ing)."""
+    params = restore_params_host(path)
+    spec = load_lora_spec(path)
+    if spec is None:
+        return params
+    from relora_tpu.core.relora import merged_params
+
+    return merged_params(params, spec)
+
+
 def load_training_state(path: str) -> dict:
     with open(os.path.join(path, TRAINING_STATE_FILE)) as f:
         return json.load(f)

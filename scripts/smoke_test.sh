@@ -79,4 +79,17 @@ echo "=== 7. analysis tools ==="
 python tools/analyze_rank.py --before "$WORK/relora/model_16" --after "$WORK/relora/model_40" | head -4
 python tools/inspect_optimizer.py "$WORK/relora/model_40" | head -3
 
+echo "=== 8. generate from the ReLoRA checkpoint (serve path) ==="
+# one-shot greedy over token-id prompts: loads model_40, merges the LoRA
+# factors, and decodes with the KV-cache engine
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --prompt "1 2 3 4" --prompt "5 6 7" --max-new-tokens 8 --cache-size 64 \
+    --eos-id -1
+# request-loop mode through the continuous-batching scheduler
+printf '1 2 3\n4 5 6 7\n8 9\n' > "$WORK/serve_requests.txt"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --input-file "$WORK/serve_requests.txt" --max-new-tokens 6 --cache-size 64 \
+    --max-batch 2 --eos-id -1 --run-dir "$WORK/serve_run"
+grep -q serve_request "$WORK/serve_run/metrics.jsonl"
+
 echo "SMOKE OK"

@@ -1,0 +1,172 @@
+"""relora-tpu inference CLI — generate from a ReLoRA (or full-rank) checkpoint.
+
+Loads a ``model_{step}`` checkpoint dir, merges any LoRA factors into the base
+kernels (train/checkpoint.restore_serving_params), and generates with the
+KV-cache engine (relora_tpu/serve).  Two modes:
+
+- one-shot: ``--prompt`` (repeatable) generates for the given prompts and
+  prints one result per line;
+- request loop: ``--input-file FILE`` (or ``-`` for stdin) reads one request
+  per line and drains them through the continuous-batching scheduler.
+
+Prompts are token ids (comma- or space-separated ints) by default, so the CLI
+has no tokenizer dependency; ``--tokenizer NAME`` opts into HF tokenization
+when ``transformers`` is installed.
+
+Examples::
+
+    # greedy one-shot over token-id prompts
+    python serve.py --checkpoint ckpts/relora/model_20000 \
+        --model_config llama_250m --prompt "1 15 27 4" --max-new-tokens 32
+
+    # sampled request loop from a file, 8 decode slots
+    python serve.py --checkpoint ckpts/relora/model_20000 \
+        --model_config llama_250m --input-file prompts.txt \
+        --temperature 0.8 --top-p 0.9 --max-batch 8 --run-dir runs/serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--checkpoint", required=True, help="model_{step} checkpoint dir")
+    p.add_argument(
+        "--model_config",
+        required=True,
+        help="zoo name (llama_35m), HF config JSON path, or dir with config.json",
+    )
+    p.add_argument("--prompt", action="append", default=[], help="one-shot prompt (repeatable)")
+    p.add_argument("--input-file", default=None, help="request file, one prompt per line ('-' = stdin)")
+    p.add_argument("--tokenizer", default=None, help="HF tokenizer name (default: token-id prompts)")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0, help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=0, help="0 disables")
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--eos-id", type=int, default=None, help="default: model config eos_token_id")
+    p.add_argument("--cache-size", type=int, default=None, help="default: max_sequence_length")
+    p.add_argument("--max-batch", type=int, default=4, help="decode slots (request-loop mode)")
+    p.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run-dir", default=None, help="metrics.jsonl destination (request-loop mode)")
+    p.add_argument("--no-scan", action="store_true", help="checkpoint was trained with scan_layers=false")
+    return p.parse_args(argv)
+
+
+def _encode(text: str, tokenizer):
+    if tokenizer is not None:
+        return tokenizer.encode(text)
+    try:
+        return [int(t) for t in text.replace(",", " ").split()]
+    except ValueError:
+        raise SystemExit(
+            f"prompt {text!r} is not a token-id list; pass --tokenizer to use text prompts"
+        )
+
+
+def _decode_tokens(tokens, tokenizer) -> str:
+    if tokenizer is not None:
+        return tokenizer.decode(tokens)
+    return " ".join(str(t) for t in tokens)
+
+
+def main(argv=None) -> int:
+    from relora_tpu.utils.logging import get_logger, honor_platform_request
+
+    honor_platform_request()
+    args = parse_args(argv)
+    logger = get_logger("relora_tpu.serve")
+
+    tokenizer = None
+    if args.tokenizer:
+        from transformers import AutoTokenizer  # optional dep, opt-in flag
+
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+
+    import jax.numpy as jnp
+
+    from relora_tpu.config.model import load_model_config
+    from relora_tpu.train.checkpoint import restore_serving_params
+
+    model_cfg = load_model_config(args.model_config)
+    logger.info(f"restoring {args.checkpoint}")
+    params = restore_serving_params(args.checkpoint)
+
+    import jax
+
+    from relora_tpu.serve.engine import InferenceEngine
+    from relora_tpu.serve.sampling import SamplingParams
+
+    cache_size = args.cache_size or model_cfg.max_sequence_length
+    eos_id = args.eos_id if args.eos_id is not None else model_cfg.eos_token_id
+    engine = InferenceEngine(
+        model_cfg,
+        params,
+        cache_size=cache_size,
+        dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        scan_layers=not args.no_scan,
+    )
+    key = jax.random.PRNGKey(args.seed)
+
+    if args.prompt:
+        prompts = [_encode(t, tokenizer) for t in args.prompt]
+        outs = engine.generate(
+            prompts,
+            max_new_tokens=args.max_new_tokens,
+            sampling=SamplingParams(
+                temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+            ),
+            eos_id=eos_id,
+            key=key,
+        )
+        for tokens in outs:
+            print(_decode_tokens(tokens, tokenizer))
+        return 0
+
+    if args.input_file is None:
+        raise SystemExit("nothing to do: pass --prompt or --input-file")
+
+    from relora_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+    from relora_tpu.utils.logging import MetricsLogger
+
+    fh = sys.stdin if args.input_file == "-" else open(args.input_file)
+    try:
+        requests = [
+            Request(
+                uid=i,
+                prompt=_encode(line, tokenizer),
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_p=args.top_p,
+            )
+            for i, line in enumerate(fh)
+            if line.strip()
+        ]
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+    if not requests:
+        raise SystemExit(f"no requests in {args.input_file}")
+
+    metrics = MetricsLogger(run_dir=args.run_dir) if args.run_dir else None
+    scheduler = ContinuousBatchingScheduler(
+        engine,
+        max_batch=args.max_batch,
+        eos_id=eos_id,
+        top_k=args.top_k,
+        metrics=metrics,
+        key=key,
+    )
+    completions = scheduler.run(requests)
+    for uid in sorted(completions):
+        print(_decode_tokens(completions[uid].tokens, tokenizer))
+    if metrics is not None:
+        metrics.finish()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

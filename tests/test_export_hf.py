@@ -1,0 +1,122 @@
+"""Smoke test for tools/export_hf.py (ISSUE satellite: the tool previously
+had no coverage): tiny config, CPU, both dtypes, and the merged-LoRA path —
+the exported state dict must be full-rank (no LoRA keys) and load back as
+plain tensors."""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec
+from relora_tpu.models.llama import LlamaForCausalLM
+from relora_tpu.models.params_util import init_params
+from relora_tpu.train.checkpoint import save_checkpoint, wait_for_save
+
+torch = pytest.importorskip("torch")
+
+sys.path.insert(0, ".")
+from tools.export_hf import main as export_main  # noqa: E402
+
+TINY = ModelConfig(
+    family="llama",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+)
+
+
+def _save_tiny_checkpoint(tmp_path, lora=None):
+    model = LlamaForCausalLM(TINY, lora=lora, dtype=jnp.float32)
+    params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    path = save_checkpoint(
+        str(tmp_path / "ckpts"), 1, {"params": params}, {"update_step": 1}, lora_spec=lora
+    )
+    wait_for_save()
+    cfg_path = tmp_path / "tiny_config.json"
+    cfg_path.write_text(
+        json.dumps(
+            {
+                "vocab_size": TINY.vocab_size,
+                "hidden_size": TINY.hidden_size,
+                "intermediate_size": TINY.intermediate_size,
+                "num_hidden_layers": TINY.num_hidden_layers,
+                "num_attention_heads": TINY.num_attention_heads,
+                "max_sequence_length": TINY.max_sequence_length,
+            }
+        )
+    )
+    return path, str(cfg_path)
+
+
+@pytest.mark.parametrize("dtype", ["f32", "bf16"])
+def test_export_merged_lora_checkpoint(tmp_path, dtype):
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0)
+    ckpt, cfg_path = _save_tiny_checkpoint(tmp_path, lora=spec)
+    out = tmp_path / "export"
+    export_main(
+        [
+            "--checkpoint", ckpt,
+            "--model_config", cfg_path,
+            "--out", str(out),
+            "--dtype", dtype,
+        ]
+    )
+    sd = torch.load(out / "pytorch_model.bin", weights_only=True)
+    assert not any("lora" in k for k in sd)
+    expected = torch.bfloat16 if dtype == "bf16" else torch.float32
+    assert all(v.dtype == expected for v in sd.values())
+    assert sd["model.embed_tokens.weight"].shape == (TINY.vocab_size, TINY.hidden_size)
+    assert sd["model.layers.0.self_attn.q_proj.weight"].shape == (64, 64)
+    hf_cfg = json.loads((out / "config.json").read_text())
+    assert hf_cfg["torch_dtype"] == ("bfloat16" if dtype == "bf16" else "float32")
+    assert hf_cfg["num_hidden_layers"] == TINY.num_hidden_layers
+
+
+def test_export_full_rank_checkpoint(tmp_path):
+    ckpt, cfg_path = _save_tiny_checkpoint(tmp_path, lora=None)
+    out = tmp_path / "export"
+    export_main(
+        ["--checkpoint", ckpt, "--model_config", cfg_path, "--out", str(out)]
+    )
+    sd = torch.load(out / "pytorch_model.bin", weights_only=True)
+    assert sd["lm_head.weight"].shape == (TINY.vocab_size, TINY.hidden_size)
+
+
+def test_restore_serving_params_merged_and_plain(tmp_path):
+    """Satellite: serve-side restore works for LoRA, full-rank, AND
+    already-merged checkpoints that kept their relora_config.json sidecar."""
+    from relora_tpu.core.relora import merged_params
+    from relora_tpu.train.checkpoint import restore_serving_params
+
+    spec = LoraSpec(r=4, alpha=32, dropout=0.0)
+    ckpt, _ = _save_tiny_checkpoint(tmp_path, lora=spec)
+    serving = restore_serving_params(ckpt)
+    flat = jax.tree_util.tree_flatten_with_path(serving)[0]
+    assert not any("lora" in jax.tree_util.keystr(p) for p, _ in flat)
+
+    # already-merged tree saved WITH the sidecar: restore must pass through
+    # (this used to require lora_a/lora_b leaves and KeyError without them)
+    merged_dir = tmp_path / "merged"
+    path2 = save_checkpoint(
+        str(merged_dir), 2, {"params": serving}, {"update_step": 2}, lora_spec=spec
+    )
+    wait_for_save()
+    again = restore_serving_params(path2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        serving,
+        again,
+    )
+
+    # full-rank checkpoint, no sidecar
+    ckpt3, _ = _save_tiny_checkpoint(tmp_path / "fr", lora=None)
+    plain = restore_serving_params(ckpt3)
+    assert "embed_tokens" in plain

@@ -1,0 +1,124 @@
+"""Sampling policy tests (ISSUE satellite): greedy == argmax, temperature→0
+converges to greedy, top-p never leaves the nucleus, top-k never leaves the
+top k, and fixed-seed determinism across jit/no-jit."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.serve.sampling import SamplingParams, sample, top_k_mask, top_p_mask
+
+pytestmark = pytest.mark.serve
+
+
+def rand_logits(key, B=4, V=50, scale=3.0):
+    return jax.random.normal(key, (B, V)) * scale
+
+
+def test_greedy_equals_argmax():
+    logits = rand_logits(jax.random.PRNGKey(0))
+    out = sample(logits, jax.random.PRNGKey(1), temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.argmax(np.asarray(logits), axis=-1))
+
+
+def test_temperature_to_zero_converges_to_greedy():
+    logits = rand_logits(jax.random.PRNGKey(2))
+    greedy = np.argmax(np.asarray(logits), axis=-1)
+    for i, temp in enumerate([0.05, 0.01, 0.001]):
+        draws = np.stack(
+            [
+                np.asarray(sample(logits, jax.random.PRNGKey(100 + i * 10 + j), temperature=temp))
+                for j in range(8)
+            ]
+        )
+        frac = (draws == greedy[None, :]).mean()
+        if temp <= 0.001:
+            assert frac == 1.0, f"temperature {temp} should be indistinguishable from greedy"
+    # and exactly-zero is exactly greedy even per-row in a mixed batch
+    temps = jnp.array([0.0, 1.0, 0.0, 1.0])
+    out = np.asarray(sample(logits, jax.random.PRNGKey(3), temperature=temps))
+    np.testing.assert_array_equal(out[[0, 2]], greedy[[0, 2]])
+
+
+def test_top_p_never_samples_outside_nucleus():
+    logits = rand_logits(jax.random.PRNGKey(4), B=8, V=32)
+    top_p = 0.7
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    # nucleus per row: smallest descending-prob prefix with mass >= top_p
+    nucleus = []
+    for row in probs:
+        order = np.argsort(row)[::-1]
+        cum = np.cumsum(row[order])
+        k = int(np.searchsorted(cum, top_p)) + 1
+        nucleus.append(set(order[:k].tolist()))
+    for seed in range(50):
+        out = np.asarray(
+            sample(logits, jax.random.PRNGKey(1000 + seed), temperature=1.0, top_p=top_p)
+        )
+        for b, tok in enumerate(out):
+            assert int(tok) in nucleus[b], f"row {b} sampled {tok} outside its nucleus"
+
+
+def test_top_p_mask_keeps_argmax():
+    """Even a tiny top_p must keep at least the most likely token."""
+    logits = rand_logits(jax.random.PRNGKey(5))
+    masked = np.asarray(top_p_mask(logits, jnp.asarray(0.01)))
+    finite = np.isfinite(np.where(masked < -1e30, -np.inf, masked))
+    assert (finite.sum(axis=-1) >= 1).all()
+    np.testing.assert_array_equal(
+        np.argmax(masked, axis=-1), np.argmax(np.asarray(logits), axis=-1)
+    )
+
+
+def test_top_k_never_samples_outside_top_k():
+    logits = rand_logits(jax.random.PRNGKey(6), B=6, V=40)
+    k = 5
+    top = np.argsort(np.asarray(logits), axis=-1)[:, -k:]
+    for seed in range(30):
+        out = np.asarray(
+            sample(logits, jax.random.PRNGKey(2000 + seed), temperature=1.5, top_k=k)
+        )
+        for b, tok in enumerate(out):
+            assert int(tok) in top[b]
+
+
+def test_fixed_seed_determinism_across_jit():
+    logits = rand_logits(jax.random.PRNGKey(7))
+    key = jax.random.PRNGKey(42)
+    kwargs = dict(temperature=0.8, top_k=10, top_p=0.9)
+    eager = np.asarray(sample(logits, key, **kwargs))
+    jitted = jax.jit(functools.partial(sample, **kwargs))
+    np.testing.assert_array_equal(np.asarray(jitted(logits, key)), eager)
+    np.testing.assert_array_equal(np.asarray(jitted(logits, key)), eager)  # stable
+
+
+def test_per_row_keys():
+    """A (B, key) stack draws each row independently: row i's draw equals a
+    single-row call with that key."""
+    logits = rand_logits(jax.random.PRNGKey(8), B=3)
+    keys = jnp.stack([jax.random.fold_in(jax.random.PRNGKey(9), i) for i in range(3)])
+    batched = np.asarray(sample(logits, keys, temperature=1.0))
+    for i in range(3):
+        solo = np.asarray(sample(logits[i : i + 1], keys[i], temperature=1.0))
+        assert batched[i] == solo[0]
+
+
+def test_sampling_params_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=-1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=1.5)
+    assert SamplingParams().temperature == 0.0
+
+
+def test_top_k_mask_disabled_passthrough():
+    logits = rand_logits(jax.random.PRNGKey(10))
+    np.testing.assert_array_equal(np.asarray(top_k_mask(logits, 0)), np.asarray(logits))
+    np.testing.assert_array_equal(
+        np.asarray(top_k_mask(logits, logits.shape[-1])), np.asarray(logits)
+    )

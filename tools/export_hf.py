@@ -25,6 +25,12 @@ def main(argv=None):
     p.add_argument("--checkpoint", required=True)
     p.add_argument("--model_config", required=True)
     p.add_argument("--out", required=True)
+    p.add_argument(
+        "--dtype",
+        choices=["f32", "bf16"],
+        default="f32",
+        help="storage dtype of the exported tensors (merge math stays f32)",
+    )
     args = p.parse_args(argv)
 
     sys.path.insert(0, ".")
@@ -50,8 +56,16 @@ def main(argv=None):
 
     import torch
 
-    torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
-               os.path.join(args.out, "pytorch_model.bin"))
+    # numpy has no native bfloat16: cast on the torch side after the f32
+    # merge/transpose work is done
+    out_dtype = torch.bfloat16 if args.dtype == "bf16" else torch.float32
+    torch.save(
+        {
+            k: torch.from_numpy(np.ascontiguousarray(np.asarray(v, np.float32))).to(out_dtype)
+            for k, v in sd.items()
+        },
+        os.path.join(args.out, "pytorch_model.bin"),
+    )
     hf_config = {
         "architectures": ["LlamaForCausalLM" if cfg.family == "llama" else "GPTNeoXForCausalLM"],
         "model_type": "llama" if cfg.family == "llama" else "gpt_neox",
@@ -70,7 +84,7 @@ def main(argv=None):
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "bos_token_id": cfg.bos_token_id,
         "eos_token_id": cfg.eos_token_id,
-        "torch_dtype": "float32",
+        "torch_dtype": "bfloat16" if args.dtype == "bf16" else "float32",
     }
     with open(os.path.join(args.out, "config.json"), "w") as f:
         json.dump(hf_config, f, indent=2)
