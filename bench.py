@@ -210,6 +210,49 @@ def main() -> None:
             pass
 
 
+def lint_main() -> None:
+    """--mode lint: run the RTL static-analysis pass over the package and
+    emit the finding counts to BENCH_lint.json.  Tracks footgun debt over
+    time: ``findings`` should only move by deliberate baseline edits, and
+    ``baseline_size`` should trend down as grandfathered violations get
+    fixed.  No devices touched (stdlib AST only)."""
+    import time
+
+    from relora_tpu.analysis import RULE_CATALOG, lint_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    baseline_path = os.path.join(repo, "tools", "lint_baseline.txt")
+    t0 = time.monotonic()
+    report = lint_paths(
+        [os.path.join(repo, "relora_tpu")],
+        root=repo,
+        baseline=baseline_path if os.path.isfile(baseline_path) else None,
+    )
+    elapsed = time.monotonic() - t0
+    result = {
+        "bench": "lint",
+        "metric": "relora-lint findings over relora_tpu/",
+        "value": len(report.findings),
+        "unit": "findings",
+        "detail": {
+            "rules_run": len(RULE_CATALOG),
+            "files_scanned": report.files_scanned,
+            "findings": len(report.findings),
+            "new": len(report.new),
+            "baselined": report.baselined,
+            "noqa_suppressed": report.noqa_suppressed,
+            "baseline_size": report.baselined + len(report.stale_baseline),
+            "stale_baseline": len(report.stale_baseline),
+            "by_rule": report.rule_counts,
+            "elapsed_sec": round(elapsed, 3),
+        },
+    }
+    out_path = os.path.join(repo, "BENCH_lint.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
 def decode_main() -> None:
     """--mode decode: benchmark the serve engine's prefill and decode steps."""
     import time
@@ -290,8 +333,11 @@ if __name__ == "__main__":
     import argparse
 
     _ap = argparse.ArgumentParser()
-    _ap.add_argument("--mode", choices=["train", "decode"], default="train")
+    _ap.add_argument("--mode", choices=["train", "decode", "lint"], default="train")
     _cli = _ap.parse_args()
+    if _cli.mode == "lint":
+        lint_main()
+        sys.exit(0)
     if _cli.mode == "decode":
         decode_main()
         sys.exit(0)

@@ -1,0 +1,54 @@
+"""relora_tpu.analysis — AST-based JAX/TPU footgun linter (stdlib-only).
+
+Rule families (full catalog in ``docs/static-analysis.md``):
+
+- RTL1xx retrace hazards (Python control flow on tracers, unhashable
+  static args, jit-inside-loop, str()/f-string on tracers)
+- RTL2xx host syncs in hot paths (.item(), float(), np.asarray,
+  block_until_ready inside the train/decode loops)
+- RTL3xx donation/aliasing (use-after-donation, missing donate_argnums)
+- RTL4xx RNG hygiene (key reuse, entropy-seeded keys)
+- RTL5xx pytree/sharding (in-place params mutation, spec-less shard_map)
+
+Usage::
+
+    python -m relora_tpu.analysis [paths] [--baseline FILE]
+
+This package deliberately imports neither jax nor numpy so it runs in a
+bare interpreter (CI lint stage) in milliseconds.
+"""
+
+from relora_tpu.analysis.core import (  # noqa: F401  (re-exports)
+    CHECKERS,
+    RULE_CATALOG,
+    BaselineEntry,
+    FileContext,
+    Finding,
+    Report,
+    format_baseline_entry,
+    lint_paths,
+    lint_text,
+    load_baseline,
+)
+
+# importing the rule modules registers their checkers/catalog entries
+from relora_tpu.analysis import (  # noqa: F401
+    rules_donation,
+    rules_hostsync,
+    rules_pytree,
+    rules_retrace,
+    rules_rng,
+)
+
+__all__ = [
+    "CHECKERS",
+    "RULE_CATALOG",
+    "BaselineEntry",
+    "FileContext",
+    "Finding",
+    "Report",
+    "format_baseline_entry",
+    "lint_paths",
+    "lint_text",
+    "load_baseline",
+]

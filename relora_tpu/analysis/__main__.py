@@ -1,0 +1,107 @@
+"""CLI: ``python -m relora_tpu.analysis [paths] [options]``.
+
+Exit codes: 0 clean (baselined/noqa'd findings allowed), 1 new findings or
+stale baseline entries, 2 usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from relora_tpu.analysis import (
+    RULE_CATALOG,
+    format_baseline_entry,
+    lint_paths,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "lint_baseline.txt"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m relora_tpu.analysis",
+        description="JAX/TPU footgun linter (RTL1xx-RTL5xx)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="files or directories to lint (default: relora_tpu/ under the repo root)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help="baseline file of grandfathered findings (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline; report every finding as new",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="print baseline lines for all NEW findings (justifications "
+        "left as TODO; paste into the baseline file and justify)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    parser.add_argument(
+        "--root",
+        default=str(REPO_ROOT),
+        help="root for repo-relative paths (default: %(default)s)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULE_CATALOG):
+            print(f"{code}  {RULE_CATALOG[code]}")
+        return 0
+
+    paths = args.paths or [str(REPO_ROOT / "relora_tpu")]
+    baseline = None
+    if not args.no_baseline and Path(args.baseline).is_file():
+        baseline = args.baseline
+
+    try:
+        report = lint_paths(paths, root=args.root, baseline=baseline)
+    except ValueError as e:  # malformed baseline
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    for f in report.new:
+        print(f.render())
+    if args.write_baseline and report.new:
+        print("\n# --- baseline lines for the findings above ---", file=sys.stderr)
+        for f in report.new:
+            print(format_baseline_entry(f), file=sys.stderr)
+    for entry in report.stale_baseline:
+        print(
+            f"{args.baseline}:{entry.lineno}: stale baseline entry "
+            f"({entry.path} | {entry.code}) no longer matches — remove it",
+            file=sys.stderr,
+        )
+    for err in report.parse_errors:
+        print(f"parse error: {err}", file=sys.stderr)
+
+    print(
+        f"[relora-lint] {report.files_scanned} files, "
+        f"{len(report.findings)} findings "
+        f"({len(report.new)} new, {report.baselined} baselined, "
+        f"{report.noqa_suppressed} noqa), "
+        f"{len(report.stale_baseline)} stale baseline entries",
+        file=sys.stderr,
+    )
+    if report.parse_errors:
+        return 2
+    if report.new or report.stale_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,356 @@
+"""Rule engine for the RTL footgun linter (stdlib-``ast``, no deps).
+
+The analysis is organized as *checkers* — functions ``(FileContext) ->
+Iterable[Finding]`` registered with :func:`checker` — each of which may emit
+findings for one or more rule codes declared in :data:`RULE_CATALOG`.  A
+finding is identified for suppression purposes by ``(relpath, code,
+stripped source line)``: line *text*, not line *number*, so baselines
+survive unrelated edits above the finding.
+
+Two suppression layers:
+
+- inline ``# noqa: RTL###`` (or a bare ``# noqa``) on the offending line,
+  for one-off intentional violations that a reader of the code should see;
+- the checked-in baseline file (``tools/lint_baseline.txt``) for
+  grandfathered findings, one per line with a mandatory justification::
+
+      relora_tpu/train/trainer.py | RTL203 | jax.block_until_ready(...) | merge cadence, timed for logging
+
+  New findings (not baselined, not noqa'd) fail the lint.  Baseline entries
+  that no longer match anything are reported as stale so the file must
+  shrink as violations are fixed, never silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Sequence, Union
+
+# code -> one-line summary; every Finding.code must be declared here
+RULE_CATALOG: Dict[str, str] = {}
+CHECKERS: List[Callable[["FileContext"], Iterable["Finding"]]] = []
+
+#: sentinel for a bare ``# noqa`` (suppresses every rule on that line)
+ALL_CODES: FrozenSet[str] = frozenset({"*"})
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*(?P<codes>RTL\d+(?:\s*,\s*RTL\d+)*))?", re.IGNORECASE
+)
+
+
+def catalog(**rules: str) -> None:
+    """Declare rule codes (``RTL101="summary"``); called at module import."""
+    for code, summary in rules.items():
+        RULE_CATALOG[code] = summary
+
+
+def checker(fn: Callable[["FileContext"], Iterable["Finding"]]):
+    CHECKERS.append(fn)
+    return fn
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, posix separators
+    line: int
+    code: str
+    message: str
+    line_text: str  # stripped source of the offending line (baseline identity)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class FileContext:
+    """One parsed file plus the per-line suppression map."""
+
+    def __init__(self, path: str, relpath: str, text: str, force_hot: bool = False):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=relpath)
+        self.force_hot = force_hot
+        self._noqa: Dict[int, FrozenSet[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _NOQA_RE.search(line)
+            if m:
+                codes = m.group("codes")
+                self._noqa[i] = (
+                    frozenset(c.strip().upper() for c in codes.split(","))
+                    if codes
+                    else ALL_CODES
+                )
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        codes = self._noqa.get(lineno)
+        return codes is not None and (codes is ALL_CODES or code in codes)
+
+    def finding(self, node: ast.AST, code: str, message: str) -> Finding:
+        assert code in RULE_CATALOG, f"undeclared rule code {code}"
+        lineno = getattr(node, "lineno", 1)
+        return Finding(self.relpath, lineno, code, message, self.line_text(lineno))
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    path: str
+    code: str
+    snippet: str
+    justification: str
+    lineno: int  # line in the baseline file (for stale reports)
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.path == self.path and f.code == self.code and f.line_text == self.snippet
+        )
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    entries: List[BaselineEntry] = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split("|", 3)]
+            if len(parts) != 4 or not parts[3]:
+                raise ValueError(
+                    f"{path}:{lineno}: baseline entries are "
+                    f"'path | RTL### | source line | justification' "
+                    f"(justification is mandatory)"
+                )
+            entries.append(BaselineEntry(parts[0], parts[1], parts[2], parts[3], lineno))
+    return entries
+
+
+def format_baseline_entry(f: Finding, justification: str = "TODO: justify") -> str:
+    return f"{f.path} | {f.code} | {f.line_text} | {justification}"
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]  # everything the rules produced (pre-suppression)
+    new: List[Finding]  # not noqa'd, not baselined -> these fail the lint
+    noqa_suppressed: int
+    baselined: int
+    stale_baseline: List[BaselineEntry]
+    files_scanned: int
+    parse_errors: List[str]
+
+    @property
+    def rule_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.code] = counts.get(f.code, 0) + 1
+        return dict(sorted(counts.items()))
+
+
+def lint_context(ctx: FileContext) -> List[Finding]:
+    found: List[Finding] = []
+    for check in CHECKERS:
+        found.extend(check(ctx))
+    return sorted(found, key=lambda f: (f.path, f.line, f.code))
+
+
+def lint_text(
+    text: str, relpath: str = "<text>", *, force_hot: bool = False
+) -> List[Finding]:
+    """Lint a source string (fixture/test entry point).  Returns raw
+    findings; ``# noqa`` suppression is applied, the baseline is not."""
+    ctx = FileContext(relpath, relpath, text, force_hot=force_hot)
+    return [f for f in lint_context(ctx) if not ctx.suppressed(f.line, f.code)]
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    skip = {".git", "__pycache__", ".venv", "node_modules", "build", "dist"}
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames if d not in skip)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    *,
+    root: Optional[str] = None,
+    baseline: Union[str, Sequence[BaselineEntry], None] = None,
+) -> Report:
+    """Lint files/trees; relpaths (finding + baseline identity) are taken
+    relative to ``root`` (default: cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    entries: List[BaselineEntry] = []
+    if isinstance(baseline, str):
+        entries = load_baseline(baseline)
+    elif baseline:
+        entries = list(baseline)
+
+    all_findings: List[Finding] = []
+    new: List[Finding] = []
+    noqa_count = 0
+    baselined_count = 0
+    used = [False] * len(entries)
+    files = 0
+    parse_errors: List[str] = []
+
+    for path in paths:
+        for fpath in _iter_py_files(path):
+            abspath = os.path.abspath(fpath)
+            relpath = os.path.relpath(abspath, root)
+            try:
+                with open(abspath, encoding="utf-8") as fh:
+                    text = fh.read()
+                ctx = FileContext(abspath, relpath, text)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                parse_errors.append(f"{relpath}: {e}")
+                continue
+            files += 1
+            for f in lint_context(ctx):
+                all_findings.append(f)
+                if ctx.suppressed(f.line, f.code):
+                    noqa_count += 1
+                    continue
+                matched = False
+                for i, entry in enumerate(entries):
+                    if entry.matches(f):
+                        used[i] = True
+                        matched = True
+                        break
+                if matched:
+                    baselined_count += 1
+                else:
+                    new.append(f)
+
+    stale = [e for e, u in zip(entries, used) if not u]
+    return Report(
+        findings=all_findings,
+        new=sorted(new, key=lambda f: (f.path, f.line, f.code)),
+        noqa_suppressed=noqa_count,
+        baselined=baselined_count,
+        stale_baseline=stale,
+        files_scanned=files,
+        parse_errors=parse_errors,
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by the rule modules
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.random.PRNGKey' for nested Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def target_path(node: ast.AST) -> str:
+    """Dotted path for assignable/loadable chains rooted at a Name
+    ('self.state.params'); '' for anything else (calls, subscripts...)."""
+    return dotted_name(node)
+
+
+def const_int_set(node: ast.AST) -> Optional[FrozenSet[int]]:
+    """The set of ints in a literal int / tuple-or-list-of-ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                vals.add(elt.value)
+            else:
+                return None
+        return frozenset(vals)
+    return None
+
+
+def const_str_set(node: ast.AST) -> Optional[FrozenSet[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.add(elt.value)
+            else:
+                return None
+        return frozenset(vals)
+    return None
+
+
+def get_kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+JIT_NAMES = frozenset({"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit"})
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in JIT_NAMES
+
+
+def unwrap_partial(node: ast.AST) -> Optional[ast.Call]:
+    """``functools.partial(jax.jit, ...)`` / ``partial(jit, ...)`` as a
+    pseudo jit-Call (kwargs of the partial are the jit kwargs)."""
+    if (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func) in ("partial", "functools.partial")
+        and node.args
+        and dotted_name(node.args[0]) in JIT_NAMES
+    ):
+        return node
+    return None
+
+
+class QualnameVisitor(ast.NodeVisitor):
+    """Base visitor tracking the dotted qualname of the enclosing
+    function/class scope ('Trainer.fit.flush_pending')."""
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func

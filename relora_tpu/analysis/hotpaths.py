@@ -1,0 +1,72 @@
+"""Which code counts as "hot path" for the RTL2xx host-sync rules.
+
+Hot means: executed once per training update or once per decode step, where
+a single stray ``.item()`` / ``np.asarray`` blocks the host on the device
+(through a TPU tunnel, for milliseconds per hit) every single step.  Code
+at save/eval/merge cadence is *not* hot — syncs there are intentional and
+either live in non-hot helper functions or carry a baseline justification.
+
+Three ways a region becomes hot, checked in order:
+
+1. the file's repo-relative path ends with a key of :data:`HOT_FUNCTIONS`
+   and the enclosing function's qualname matches one of the listed
+   prefixes (an empty-string prefix marks the whole file, module level
+   included);
+2. the file contains the literal marker comment ``relora-lint: hot-path``
+   (whole file; used by fixtures and by new modules that want the strict
+   rules without editing this table);
+3. the ``FileContext`` was built with ``force_hot=True`` (tests).
+
+The sanctioned fix for a genuine sync need is to move it into a helper
+*outside* the hot functions, called at a logging/metrics cadence —
+``train/trainer._pull_metric_records`` is the model citizen.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from relora_tpu.analysis.core import FileContext
+
+#: repo-relative path suffix -> hot function qualname prefixes ("" = whole file)
+HOT_FUNCTIONS: Dict[str, List[str]] = {
+    "relora_tpu/train/step.py": [""],  # every step builder is jitted hot code
+    "relora_tpu/train/trainer.py": [
+        "Trainer.fit",  # the update loop, including nested closures
+        "Trainer._prefetched",
+        "Trainer.evaluate",  # per-batch eval loop (syncs every sync_every)
+    ],
+    "relora_tpu/serve/engine.py": [
+        "InferenceEngine.prefill",
+        "InferenceEngine.decode",
+        "InferenceEngine.insert",
+        "InferenceEngine.init_cache",
+    ],
+    "relora_tpu/serve/sampling.py": [""],  # jitted per decode step
+    "relora_tpu/serve/scheduler.py": [
+        "ContinuousBatchingScheduler.run",  # the decode loop
+        "ContinuousBatchingScheduler._sample_rows",  # per decode step
+    ],
+}
+
+HOT_MARKER = "relora-lint: hot-path"
+
+
+def hot_prefixes(ctx: FileContext) -> Sequence[str]:
+    """Hot qualname prefixes for this file; empty sequence = nothing hot.
+    A [""] result marks the whole file (module level included)."""
+    if ctx.force_hot or HOT_MARKER in ctx.text:
+        return [""]
+    for suffix, prefixes in HOT_FUNCTIONS.items():
+        if ctx.relpath.endswith(suffix):
+            return prefixes
+    return ()
+
+
+def qualname_is_hot(qualname: str, prefixes: Sequence[str]) -> bool:
+    for prefix in prefixes:
+        if prefix == "":
+            return True
+        if qualname == prefix or qualname.startswith(prefix + "."):
+            return True
+    return False

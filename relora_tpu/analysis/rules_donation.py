@@ -1,0 +1,355 @@
+"""RTL3xx — buffer donation and aliasing.
+
+``donate_argnums`` lets XLA reuse an input buffer for the output — the only
+way the big train-state/KV-cache updates run without doubling their memory
+footprint.  Two ways to get it wrong:
+
+- RTL301: **use after donation** — reading a donated argument after the
+  jitted call returns.  The buffer now holds the *output* (or garbage);
+  JAX raises on CPU but on TPU a deleted-buffer read can surface as a
+  cryptic error far from the cause.  Rebind the result over the donated
+  name in the same statement (``state, m = step(state, ...)``).
+- RTL302: **missing donation** — a same-module jitted function whose
+  parameters include large mutable state (named ``state`` / ``opt_state``
+  / ``cache`` / ``dcache``) with no ``donate_argnums``/``donate_argnames``:
+  every call allocates a second copy of that state.  Parameter trees that
+  are *reused* across calls (e.g. eval ``params``) must NOT be donated —
+  hence the rule keys on the state-like names only.
+
+Scope: donation tracking is per-module and per-class (``self._step =
+jax.jit(..., donate_argnums=...)`` assignments are visible to every method
+of the class).  Cross-object aliasing (another object's donated buffers)
+is out of reach for an AST pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from relora_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    catalog,
+    checker,
+    const_int_set,
+    dotted_name,
+    get_kwarg,
+    is_jit_call,
+    target_path,
+    unwrap_partial,
+)
+
+catalog(
+    RTL301="donated argument read after the jitted call (buffer reused by the output)",
+    RTL302="jitted function with large-state params lacks donate_argnums (doubles state memory per call)",
+)
+
+DONATABLE = frozenset({"state", "opt_state", "cache", "dcache"})
+
+
+def _donated_nums(call: ast.Call) -> Optional[FrozenSet[int]]:
+    """Donated positions of a jit call; None when not a donating jit."""
+    val = get_kwarg(call, "donate_argnums")
+    if val is None:
+        return None
+    return const_int_set(val) or frozenset()
+
+
+def _collect_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _wrapped_params(call: ast.Call, defs) -> Optional[List[str]]:
+    """Positional parameter names of the function a jit call wraps, when
+    resolvable (local def, lambda, or partial of a local def)."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return [a.arg for a in target.args.posonlyargs + target.args.args]
+    if isinstance(target, ast.Call) and dotted_name(target.func) in (
+        "partial",
+        "functools.partial",
+    ):
+        if target.args and isinstance(target.args[0], ast.Name):
+            target = target.args[0]
+        else:
+            return None
+    if isinstance(target, ast.Name):
+        fn = defs.get(target.id)
+        if fn is not None:
+            return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RTL301: in-order use-after-donation simulation
+
+
+class _Events:
+    """In-order load/store/consume event stream for one function body,
+    honoring Python evaluation order (values before targets; call
+    arguments before the donation takes effect).  Loop bodies replay
+    twice so a consume at the bottom meets the loads at the top."""
+
+    def __init__(self, donating: Dict[str, FrozenSet[int]]):
+        self.donating = donating
+        self.stream: List[Tuple[str, str, ast.AST]] = []
+
+    def expr(self, node: ast.AST) -> None:
+        if node is None:
+            return
+        path = target_path(node)
+        if path:
+            self.stream.append(("load", path, node))
+            return
+        if isinstance(node, ast.Call):
+            self.expr(node.func)
+            for arg in node.args:
+                self.expr(arg)
+            for kw in node.keywords:
+                self.expr(kw.value)
+            callee = target_path(node.func)
+            donated = self.donating.get(callee)
+            if donated:
+                for i in donated:
+                    if i < len(node.args):
+                        arg_path = target_path(node.args[i])
+                        if arg_path:
+                            self.stream.append(("consume", arg_path, node.args[i]))
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.expr(child)
+
+    def store(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self.store(elt)
+            return
+        if isinstance(node, ast.Starred):
+            self.store(node.value)
+            return
+        path = target_path(node)
+        if path:
+            self.stream.append(("store", path, node))
+        elif isinstance(node, ast.Subscript):
+            # writing into a slot of a donated buffer is also a use
+            self.expr(node.value)
+
+    def stmts(self, body) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                self.expr(stmt.value)
+                for tgt in stmt.targets:
+                    self.store(tgt)
+            elif isinstance(stmt, ast.AnnAssign):
+                self.expr(stmt.value)
+                self.store(stmt.target)
+            elif isinstance(stmt, ast.AugAssign):
+                self.expr(stmt.value)
+                self.expr(stmt.target)
+                self.store(stmt.target)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.expr(stmt.iter)
+                for _ in range(2):  # two passes: catch cross-iteration reads
+                    self.store(stmt.target)
+                    self.stmts(stmt.body)
+                self.stmts(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                for _ in range(2):
+                    self.expr(stmt.test)
+                    self.stmts(stmt.body)
+                self.stmts(stmt.orelse)
+            elif isinstance(stmt, ast.If):
+                self.expr(stmt.test)
+                self.stmts(stmt.body)
+                self.stmts(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self.expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        self.store(item.optional_vars)
+                self.stmts(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.stmts(stmt.body)
+                for handler in stmt.handlers:
+                    self.stmts(handler.body)
+                self.stmts(stmt.orelse)
+                self.stmts(stmt.finalbody)
+            elif isinstance(stmt, (ast.Expr, ast.Return, ast.Assert, ast.Raise)):
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self.expr(child)
+            elif isinstance(stmt, ast.FunctionDef):
+                self.stmts(stmt.body)  # closure over the same locals
+
+
+def _related(a: str, b: str) -> bool:
+    return a == b or a.startswith(b + ".") or b.startswith(a + ".")
+
+
+def _simulate(
+    ctx: FileContext, fn: ast.FunctionDef, donating: Dict[str, FrozenSet[int]]
+) -> Iterator[Finding]:
+    ev = _Events(donating)
+    ev.stmts(fn.body)
+    consumed: Dict[str, int] = {}  # path -> line of the donating call
+    reported: Set[Tuple[str, int]] = set()
+    for kind, path, node in ev.stream:
+        if kind == "store":
+            for c in [c for c in consumed if _related(c, path)]:
+                del consumed[c]
+        elif kind == "consume":
+            consumed[path] = getattr(node, "lineno", 0)
+        elif kind == "load":
+            for c, at_line in consumed.items():
+                if path == c or path.startswith(c + "."):
+                    key = (path, getattr(node, "lineno", 0))
+                    if key not in reported:
+                        reported.add(key)
+                        yield ctx.finding(
+                            node,
+                            "RTL301",
+                            f"`{path}` was donated to the jitted call at line "
+                            f"{at_line} and read afterwards — the buffer now "
+                            "holds the output; rebind the result over the "
+                            "donated name",
+                        )
+                    break
+
+
+def _scope_locals(body, out: Dict[str, FrozenSet[int]]) -> Dict[str, FrozenSet[int]]:
+    """Donating jit assignments to bare names within one scope's statements
+    (nested function/class bodies excluded — they are their own scopes).
+    A non-donating jit rebind records an empty set, shadowing any inherited
+    donating binding of the same name."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.Assign) and is_jit_call(stmt.value):
+            donated = _donated_nums(stmt.value) or frozenset()
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = donated
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                _scope_locals(sub, out)
+        for handler in getattr(stmt, "handlers", []):
+            _scope_locals(handler.body, out)
+    return out
+
+
+def _scoped_registries(
+    tree: ast.Module, shared: Dict[str, FrozenSet[int]]
+) -> Dict[int, Dict[str, FrozenSet[int]]]:
+    """Per-FunctionDef donation registry: `shared` (attribute paths like
+    ``self._step``, donating decorated defs) + module-level names + the
+    locals of every enclosing function.  Bare-name jit bindings are
+    function-scoped on purpose — two test functions both naming their
+    callable ``step`` must not see each other's donate_argnums."""
+    per_fn: Dict[int, Dict[str, FrozenSet[int]]] = {}
+
+    def recurse(body, inherited: Dict[str, FrozenSet[int]]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                own = dict(inherited)
+                _scope_locals(stmt.body, own)
+                per_fn[id(stmt)] = {**shared, **own}
+                recurse(stmt.body, own)
+            elif isinstance(stmt, ast.ClassDef):
+                recurse(stmt.body, inherited)
+            else:
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        recurse(sub, inherited)
+                for handler in getattr(stmt, "handlers", []):
+                    recurse(handler.body, inherited)
+
+    recurse(tree.body, _scope_locals(tree.body, {}))
+    return per_fn
+
+
+@checker
+def check_donation(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    defs = _collect_defs(ctx.tree)
+
+    # -- collect donating callables reachable from any scope ----------------
+    # dotted attribute paths (`self._step = jax.jit(..., donate_argnums=..)`)
+    # are visible class/module-wide; bare names are scoped per function below
+    donating: Dict[str, FrozenSet[int]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and is_jit_call(node.value):
+            donated = _donated_nums(node.value)
+            if donated:
+                for tgt in node.targets:
+                    path = target_path(tgt)
+                    if path and "." in path:
+                        donating[path] = donated
+
+    for node in ast.walk(ctx.tree):
+        if not is_jit_call(node):
+            continue
+        if (
+            _donated_nums(node) is not None
+            or get_kwarg(node, "donate_argnames") is not None
+        ):
+            continue
+        params = _wrapped_params(node, defs)
+        if not params:
+            continue
+        stateful = [p for p in params if p in DONATABLE]
+        if stateful:
+            findings.append(
+                ctx.finding(
+                    node,
+                    "RTL302",
+                    f"jitted function takes large state ({', '.join(stateful)}) "
+                    "but has no donate_argnums — every call allocates a second "
+                    "copy of that state",
+                )
+            )
+
+    # decorated defs: bare `@jax.jit` (or a jit/partial call without donate
+    # kwargs) on a def with state-like params is the same missing-donation
+    # bug; with donate_argnums it registers the def as a donating callable.
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            call = dec if is_jit_call(dec) else unwrap_partial(dec)
+            is_bare_jit = dotted_name(dec) in ("jit", "jax.jit")
+            if call is None and not is_bare_jit:
+                continue
+            donated = _donated_nums(call) if call is not None else None
+            names_kw = (
+                get_kwarg(call, "donate_argnames") if call is not None else None
+            )
+            if donated:
+                donating.setdefault(fn.name, donated)
+            elif donated is None and names_kw is None:
+                params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+                stateful = [p for p in params if p in DONATABLE]
+                if stateful:
+                    findings.append(
+                        ctx.finding(
+                            fn,
+                            "RTL302",
+                            f"jitted function takes large state "
+                            f"({', '.join(stateful)}) but has no "
+                            "donate_argnums — every call allocates a second "
+                            "copy of that state",
+                        )
+                    )
+
+    # -- RTL301: simulate each function against its scoped registry ---------
+    registries = _scoped_registries(ctx.tree, donating)
+    for fn in defs.values():
+        findings.extend(_simulate(ctx, fn, registries.get(id(fn), donating)))
+    return findings

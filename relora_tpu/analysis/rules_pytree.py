@@ -1,0 +1,191 @@
+"""RTL5xx — pytree and sharding discipline.
+
+- RTL501: in-place mutation of a params-like *parameter* (``params``,
+  ``state``, ``opt_state``, ``tree``, ``pytree``, ``variables``) received
+  by a function: subscript stores/deletes and dict-mutators
+  (``update``/``pop``/``setdefault``/``clear``/``popitem``).  Inside jit
+  the mutation silently bakes into the trace; outside it aliases the
+  caller's tree (the optimizer state the caller still holds now disagrees
+  with checkpoints).  Build a new dict ``{**params, name: new}`` instead.
+  Rebinding the name locally (``params = dict(params)``) transfers
+  ownership and clears the rule.
+- RTL502: ``shard_map`` without explicit ``in_specs``/``out_specs`` kwargs
+  or ``pjit`` without ``in_shardings``/``out_shardings``: the defaults
+  infer replication, which silently materializes the full tensor on every
+  device — the exact opposite of what a sharded train step wants.  Passing
+  the specs positionally (4+ positional args to shard_map) also counts as
+  explicit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from relora_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    catalog,
+    checker,
+    dotted_name,
+    get_kwarg,
+)
+
+catalog(
+    RTL501="in-place mutation of a borrowed params/state pytree argument",
+    RTL502="shard_map/pjit without explicit sharding specs (silently replicates)",
+)
+
+PARAMS_NAMES = frozenset(
+    {"params", "state", "opt_state", "tree", "pytree", "variables"}
+)
+DICT_MUTATORS = frozenset({"update", "pop", "setdefault", "clear", "popitem"})
+
+SHARD_MAP_NAMES = frozenset({"shard_map", "jax.experimental.shard_map.shard_map"})
+PJIT_NAMES = frozenset({"pjit", "jax.experimental.pjit.pjit"})
+
+
+def _mutator_calls(node: ast.AST, borrowed: Set[str]):
+    """Yield dict-mutator Call nodes on borrowed names anywhere in an
+    expression, without descending into lambdas (own scope)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            func = sub.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in DICT_MUTATORS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in borrowed
+            ):
+                yield sub
+
+
+def _scan_body(ctx: FileContext, body, borrowed: Set[str], findings: List[Finding]):
+    """Source-ordered walk of a statement list; nested defs are skipped
+    (they get their own scan with their own parameter list)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.Assign):
+            for call in _mutator_calls(stmt.value, borrowed):
+                findings.append(_mutator_finding(ctx, call))
+            # local rebind transfers ownership: params = dict(params)
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in borrowed:
+                    borrowed.discard(tgt.id)
+                elif (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in borrowed
+                ):
+                    findings.append(
+                        ctx.finding(
+                            tgt,
+                            "RTL501",
+                            f"in-place store into borrowed `{tgt.value.id}` — "
+                            "mutates the caller's tree (and bakes into the "
+                            "trace under jit); build a new dict "
+                            f"{{**{tgt.value.id}, ...}} instead",
+                        )
+                    )
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in borrowed
+                ):
+                    findings.append(
+                        ctx.finding(
+                            tgt,
+                            "RTL501",
+                            f"del on borrowed `{tgt.value.id}` — mutates the "
+                            "caller's tree; copy before pruning",
+                        )
+                    )
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    for call in _mutator_calls(child, borrowed):
+                        findings.append(_mutator_finding(ctx, call))
+            for field in ("body", "orelse", "finalbody"):
+                sub_body = getattr(stmt, field, None)
+                if sub_body:
+                    _scan_body(ctx, sub_body, borrowed, findings)
+            for handler in getattr(stmt, "handlers", []):
+                _scan_body(ctx, handler.body, borrowed, findings)
+
+
+def _mutator_finding(ctx: FileContext, call: ast.Call) -> Finding:
+    func = call.func
+    return ctx.finding(
+        call,
+        "RTL501",
+        f".{func.attr}() on borrowed `{func.value.id}` — in-place mutation "
+        "of the caller's tree; build a new dict instead",
+    )
+
+
+def _scan_function(ctx: FileContext, fn) -> List[Finding]:
+    findings: List[Finding] = []
+    borrowed: Set[str] = {
+        a.arg
+        for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        if a.arg in PARAMS_NAMES
+    }
+    if borrowed:
+        _scan_body(ctx, fn.body, borrowed, findings)
+    return findings
+
+
+@checker
+def check_pytree(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_scan_function(ctx, node))
+    return findings
+
+
+@checker
+def check_sharding(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in SHARD_MAP_NAMES:
+            # shard_map(f, mesh, in_specs=..., out_specs=...); specs may also
+            # arrive positionally (f, mesh, in_specs, out_specs) = 4+ args
+            if len(node.args) >= 4:
+                continue
+            missing = [
+                kw
+                for kw in ("in_specs", "out_specs")
+                if get_kwarg(node, kw) is None
+            ]
+            if missing:
+                findings.append(
+                    ctx.finding(
+                        node,
+                        "RTL502",
+                        f"shard_map without {'/'.join(missing)} — the default "
+                        "infers replication and materializes full tensors on "
+                        "every device; spell the specs out",
+                    )
+                )
+        elif name in PJIT_NAMES:
+            if (
+                get_kwarg(node, "in_shardings") is None
+                and get_kwarg(node, "out_shardings") is None
+                and len(node.args) < 2
+            ):
+                findings.append(
+                    ctx.finding(
+                        node,
+                        "RTL502",
+                        "pjit without in_shardings/out_shardings — defaults "
+                        "to replication; pass explicit NamedSharding specs",
+                    )
+                )
+    return findings

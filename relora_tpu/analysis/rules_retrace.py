@@ -1,0 +1,345 @@
+"""RTL1xx — retrace hazards.
+
+jit compiles one program per (shape, dtype, static-value) signature.  Code
+that branches Python-side on *traced* values either crashes at trace time
+(ConcretizationTypeError) or, worse, silently retraces every call — the
+failure mode that killed throughput in the serve scheduler's early drafts
+(the whole slot design exists so decode never retraces).
+
+- RTL101: Python ``if``/``while`` on a value derived from a traced
+  argument inside a jitted function.  Use ``jnp.where`` / ``lax.cond`` /
+  ``lax.while_loop``.  (``x is None`` / ``isinstance`` tests and
+  ``.shape``/``.ndim``/``.dtype``-derived conditions are static — fine.)
+- RTL102: unhashable or array-valued argument in a static position of a
+  jitted call — every call with a fresh list/dict/array retraces (or
+  throws).  Pass tuples / hashable scalars.
+- RTL103: ``jax.jit(...)`` constructed inside a loop — a fresh jit wrapper
+  per iteration defeats the compile cache at best.  Build the jitted
+  callable once, outside.
+- RTL104: f-string / ``str()`` / ``print()`` on a traced value inside a
+  jitted function — formats the tracer object (never the runtime value)
+  and bakes the formatted garbage into the compiled program.  Use
+  ``jax.debug.print``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from relora_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    catalog,
+    checker,
+    const_int_set,
+    const_str_set,
+    dotted_name,
+    get_kwarg,
+    is_jit_call,
+    unwrap_partial,
+)
+
+catalog(
+    RTL101="Python if/while on a traced value inside a jitted function (use jnp.where/lax.cond/lax.while_loop)",
+    RTL102="unhashable/array-valued argument in a static position of a jitted call (retraces every call)",
+    RTL103="jax.jit constructed inside a loop (build the jitted callable once, outside)",
+    RTL104="f-string/str()/print() on a traced value inside a jitted function (formats the tracer; use jax.debug.print)",
+)
+
+# attribute reads that yield static (trace-time) values, not tracers
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+# calls whose result is static regardless of argument taint
+STATIC_CALLS = frozenset({"len", "isinstance", "type", "hasattr", "getattr"})
+ARRAYISH_CALLS = frozenset(
+    {"np.array", "np.asarray", "numpy.array", "numpy.asarray", "jnp.array", "jnp.asarray"}
+)
+STR_CALLS = frozenset({"str", "repr", "format", "print"})
+
+
+def _jit_statics(call: ast.Call) -> Tuple[FrozenSet[int], FrozenSet[str]]:
+    """(static positions, static names) from a jit(-like) call's kwargs."""
+    nums = get_kwarg(call, "static_argnums")
+    names = get_kwarg(call, "static_argnames")
+    return (
+        const_int_set(nums) or frozenset() if nums is not None else frozenset(),
+        const_str_set(names) or frozenset() if names is not None else frozenset(),
+    )
+
+
+def _collect_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _jitted_functions(
+    tree: ast.Module, defs: Dict[str, ast.FunctionDef]
+) -> Dict[int, Tuple[ast.FunctionDef, FrozenSet[int], FrozenSet[str]]]:
+    """Functions traced by jit: decorated (@jax.jit, @partial(jax.jit, ...))
+    or referenced by name in a same-module ``jax.jit(fn, ...)`` call.
+    Keyed by id(funcdef) to dedupe."""
+    jitted: Dict[int, Tuple[ast.FunctionDef, FrozenSet[int], FrozenSet[str]]] = {}
+
+    def mark(fn: ast.FunctionDef, call: Optional[ast.Call]) -> None:
+        nums, names = _jit_statics(call) if call is not None else (frozenset(), frozenset())
+        jitted.setdefault(id(fn), (fn, nums, names))
+
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            if dotted_name(dec) in ("jit", "jax.jit", "pjit"):
+                mark(fn, None)
+            elif is_jit_call(dec):  # @jax.jit(static_argnums=...)
+                mark(fn, dec)
+            elif unwrap_partial(dec) is not None:  # @partial(jax.jit, ...)
+                mark(fn, unwrap_partial(dec))
+    for node in ast.walk(tree):
+        if is_jit_call(node) and node.args and isinstance(node.args[0], ast.Name):
+            target = defs.get(node.args[0].id)
+            if target is not None:
+                mark(target, node)
+    return jitted
+
+
+class _Taint:
+    """Statement-ordered taint propagation through one jitted function."""
+
+    def __init__(self, ctx: FileContext, fn: ast.FunctionDef, tainted: Set[str]):
+        self.ctx = ctx
+        self.tainted = tainted
+        self.findings: List[Finding] = []
+        self._seen_lines: Set[Tuple[int, str]] = set()
+
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        key = (getattr(node, "lineno", 0), code)
+        if key not in self._seen_lines:
+            self._seen_lines.add(key)
+            self.findings.append(self.ctx.finding(node, code, message))
+
+    # -- expression taint --------------------------------------------------
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in STATIC_CALLS:
+                return False
+            parts = [node.func] + list(node.args) + [kw.value for kw in node.keywords]
+            return any(self.expr_tainted(p) for p in parts)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None`: static trace-time dispatch
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return False
+            return any(
+                self.expr_tainted(c) for c in [node.left] + list(node.comparators)
+            )
+        if isinstance(node, (ast.expr,)):
+            return any(
+                self.expr_tainted(child)
+                for child in ast.iter_child_nodes(node)
+                if isinstance(child, ast.expr)
+            )
+        return False
+
+    # -- RTL104 scan over one statement's expressions ----------------------
+
+    def scan_strings(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.JoinedStr):
+                for value in sub.values:
+                    if isinstance(value, ast.FormattedValue) and self.expr_tainted(
+                        value.value
+                    ):
+                        self._emit(
+                            sub,
+                            "RTL104",
+                            "f-string interpolates a traced value inside a jitted "
+                            "function (formats the tracer; use jax.debug.print)",
+                        )
+                        break
+            elif isinstance(sub, ast.Call) and dotted_name(sub.func) in STR_CALLS:
+                if any(self.expr_tainted(a) for a in sub.args):
+                    self._emit(
+                        sub,
+                        "RTL104",
+                        f"{dotted_name(sub.func)}() on a traced value inside a "
+                        "jitted function (formats the tracer; use jax.debug.print)",
+                    )
+
+    # -- statement walk ----------------------------------------------------
+
+    def _assign_targets(self, targets, value_tainted: bool) -> None:
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if value_tainted:
+                    self.tainted.add(tgt.id)
+                else:
+                    self.tainted.discard(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                self._assign_targets(tgt.elts, value_tainted)
+            elif isinstance(tgt, ast.Starred):
+                self._assign_targets([tgt.value], value_tainted)
+
+    def run(self, body) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                self.scan_strings(stmt.value)
+                self._assign_targets(stmt.targets, self.expr_tainted(stmt.value))
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self.scan_strings(stmt.value)
+                self._assign_targets([stmt.target], self.expr_tainted(stmt.value))
+            elif isinstance(stmt, ast.AugAssign):
+                self.scan_strings(stmt.value)
+                if self.expr_tainted(stmt.value):
+                    self._assign_targets([stmt.target], True)
+            elif isinstance(stmt, ast.If):
+                self.scan_strings(stmt.test)
+                if self.expr_tainted(stmt.test):
+                    self._emit(
+                        stmt,
+                        "RTL101",
+                        "`if` on a traced value inside a jitted function "
+                        "(ConcretizationTypeError or silent retrace; use "
+                        "jnp.where/lax.cond)",
+                    )
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self.scan_strings(stmt.test)
+                if self.expr_tainted(stmt.test):
+                    self._emit(
+                        stmt,
+                        "RTL101",
+                        "`while` on a traced value inside a jitted function "
+                        "(use lax.while_loop/lax.fori_loop)",
+                    )
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, ast.For):
+                self.scan_strings(stmt.iter)
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, (ast.With,)):
+                for item in stmt.items:
+                    self.scan_strings(item.context_expr)
+                self.run(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.run(stmt.body)
+                for handler in stmt.handlers:
+                    self.run(handler.body)
+                self.run(stmt.orelse)
+                self.run(stmt.finalbody)
+            elif isinstance(stmt, ast.FunctionDef):
+                # nested def: traced as a closure when called from the
+                # jitted body — propagate the current taint through it
+                self.run(stmt.body)
+            elif isinstance(stmt, (ast.Expr, ast.Return, ast.Assert, ast.Raise)):
+                self.scan_strings(stmt)
+
+
+def _tainted_params(
+    fn: ast.FunctionDef, static_nums: FrozenSet[int], static_names: FrozenSet[str]
+) -> Set[str]:
+    names: Set[str] = set()
+    params = fn.args.posonlyargs + fn.args.args
+    for i, arg in enumerate(params):
+        if arg.arg in ("self", "cls"):
+            continue
+        if i in static_nums or arg.arg in static_names:
+            continue
+        names.add(arg.arg)
+    for arg in fn.args.kwonlyargs:
+        if arg.arg not in static_names:
+            names.add(arg.arg)
+    return names
+
+
+@checker
+def check_retrace(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    defs = _collect_defs(ctx.tree)
+    jitted = _jitted_functions(ctx.tree, defs)
+
+    # RTL101 + RTL104: taint pass over each jitted function
+    for fn, nums, names in jitted.values():
+        taint = _Taint(ctx, fn, _tainted_params(fn, nums, names))
+        taint.run(fn.body)
+        findings.extend(taint.findings)
+
+    # RTL102: unhashable literals at static call positions.
+    # Map names bound to `jax.jit(f, static_argnums=...)` results, then
+    # check their call sites.
+    static_by_name: Dict[str, FrozenSet[int]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and is_jit_call(node.value):
+            nums, _ = _jit_statics(node.value)
+            if nums:
+                for tgt in node.targets:
+                    path = dotted_name(tgt)
+                    if path:
+                        static_by_name[path] = nums
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        static_positions: Optional[FrozenSet[int]] = static_by_name.get(callee)
+        if static_positions is None and is_jit_call(node.func):
+            # direct `jax.jit(f, static_argnums=...)(args)` call
+            static_positions, _ = _jit_statics(node.func)
+        if not static_positions:
+            continue
+        for i in static_positions:
+            if i < len(node.args):
+                arg = node.args[i]
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(arg, ast.Call)
+                    and dotted_name(arg.func) in ARRAYISH_CALLS
+                ):
+                    findings.append(
+                        ctx.finding(
+                            arg,
+                            "RTL102",
+                            f"unhashable/array-valued argument at static position "
+                            f"{i} of jitted call {callee or 'jax.jit(...)'} "
+                            f"(retraces or throws every call; pass a tuple/scalar)",
+                        )
+                    )
+
+    # RTL103: jit construction inside a loop
+    loop_stack = 0
+
+    def walk_loops(node: ast.AST) -> None:
+        nonlocal loop_stack
+        is_loop = isinstance(node, (ast.For, ast.While, ast.AsyncFor))
+        if is_loop:
+            loop_stack += 1
+        for child in ast.iter_child_nodes(node):
+            if (
+                loop_stack > 0
+                and (is_jit_call(child) or unwrap_partial(child) is not None)
+            ):
+                findings.append(
+                    ctx.finding(
+                        child,
+                        "RTL103",
+                        "jax.jit constructed inside a loop — build the jitted "
+                        "callable once outside (a fresh wrapper per iteration "
+                        "defeats the compile cache)",
+                    )
+                )
+            walk_loops(child)
+        if is_loop:
+            loop_stack -= 1
+
+    walk_loops(ctx.tree)
+    return findings

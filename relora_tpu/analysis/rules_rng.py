@@ -1,0 +1,253 @@
+"""RTL4xx — PRNG key hygiene.
+
+JAX PRNG keys are values, not stateful generators: passing the same key to
+two distribution calls yields *identical* randomness — dropout masks that
+repeat every step, LoRA re-inits that collide across restarts — and nothing
+crashes.  The repo's convention (see ``utils/random.py`` idiom) is
+``key, sub = jax.random.split(key)`` before every consumption and
+``fold_in(key, step)`` for per-step streams.
+
+- RTL401: the same key expression is passed to two *consuming* calls
+  (distribution samplers) without an intervening ``split``/``fold_in``
+  rebind.  Derivation calls (``split``, ``fold_in``, ``PRNGKey``) don't
+  consume, they create.
+- RTL402: a key seeded from wallclock/OS entropy (``time.*``,
+  ``os.urandom``/``os.getpid``, ``random.*``, ``uuid.*``, ``secrets.*``)
+  — runs are unreproducible and restarts silently resample; seeds must
+  come from config.
+
+Identity for RTL401 is the unparsed expression text within one function
+body, reset on any rebind of the root name; cross-function flows and
+subscripted key arrays are out of scope (and rarely misused in practice).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from relora_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    catalog,
+    checker,
+    dotted_name,
+)
+
+catalog(
+    RTL401="PRNG key consumed twice without split/fold_in (identical randomness)",
+    RTL402="PRNG key seeded from wallclock/OS entropy (unreproducible runs)",
+)
+
+#: jax.random callables that CONSUME a key (same key twice = same samples)
+CONSUMERS = frozenset(
+    {
+        "bernoulli",
+        "categorical",
+        "choice",
+        "dirichlet",
+        "exponential",
+        "gamma",
+        "gumbel",
+        "laplace",
+        "normal",
+        "permutation",
+        "poisson",
+        "randint",
+        "shuffle",
+        "truncated_normal",
+        "uniform",
+    }
+)
+#: derive a new key or stream — not a consumption
+DERIVERS = frozenset({"split", "fold_in", "PRNGKey", "key", "clone"})
+
+BAD_SEED_CALLS = frozenset(
+    {
+        "time.time",
+        "time.time_ns",
+        "time.monotonic",
+        "time.perf_counter",
+        "os.urandom",
+        "os.getpid",
+        "random.random",
+        "random.randint",
+        "random.getrandbits",
+        "uuid.uuid4",
+        "uuid.uuid1",
+        "secrets.randbits",
+        "secrets.token_bytes",
+    }
+)
+
+
+def _random_fn(name: str) -> str:
+    """'uniform' from 'jax.random.uniform' / 'jrandom.uniform' / 'random.normal';
+    '' when the call is not a jax.random-style function."""
+    if not name:
+        return ""
+    head, _, tail = name.rpartition(".")
+    if tail in CONSUMERS | DERIVERS:
+        # require a random-ish namespace (or bare name imported from it)
+        if head == "" or head.endswith("random") or head in ("jr", "jrandom", "jax.random"):
+            return tail
+    return ""
+
+
+def _root_name(node: ast.AST) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+class _FnScanner:
+    """Per-function scan in source order.  ``seen`` maps key-expression text
+    -> line of first consumption; a rebind of the root name clears it."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def scan(self, fn: ast.AST) -> None:
+        seen: Dict[str, int] = {}
+        self._walk(fn.body, seen)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _clear_root(self, seen: Dict[str, int], root: str) -> None:
+        if not root:
+            return
+        for expr in [e for e in seen if _root_of_text(e) == root]:
+            del seen[expr]
+
+    def _handle_call(self, call: ast.Call, seen: Dict[str, int]) -> None:
+        fn_name = dotted_name(call.func)
+        tail = _random_fn(fn_name)
+        if not tail or not call.args:
+            return
+        key_arg = call.args[0]
+        try:
+            key_text = ast.unparse(key_arg)
+        except Exception:  # pragma: no cover - unparse is total on 3.10
+            return
+        if tail in DERIVERS:
+            return  # split/fold_in consume nothing; rebind handled at Assign
+        prev = seen.get(key_text)
+        if prev is not None:
+            self.findings.append(
+                self.ctx.finding(
+                    call,
+                    "RTL401",
+                    f"key `{key_text}` already consumed at line {prev} — "
+                    "reusing it yields identical randomness; "
+                    "`key, sub = jax.random.split(key)` first",
+                )
+            )
+        else:
+            seen[key_text] = call.lineno
+
+    def _walk_expr(self, node: ast.AST, seen: Dict[str, int]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._handle_call(sub, seen)
+
+    def _walk(self, body, seen: Dict[str, int]) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                self._walk_expr(stmt.value, seen)
+                for tgt in stmt.targets:
+                    for leaf in ast.walk(tgt):
+                        if isinstance(leaf, (ast.Name, ast.Attribute)):
+                            self._clear_root(seen, _root_name(leaf))
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    self._walk_expr(stmt.value, seen)
+                self._clear_root(seen, _root_name(stmt.target))
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With, ast.Try)):
+                for field in ("test", "iter"):
+                    val = getattr(stmt, field, None)
+                    if val is not None:
+                        self._walk_expr(val, seen)
+                if isinstance(stmt, ast.For):
+                    for leaf in ast.walk(stmt.target):
+                        if isinstance(leaf, ast.Name):
+                            self._clear_root(seen, leaf.id)
+                # branches see the same pre-branch state; if/else arms are
+                # exclusive at runtime, so give each arm an isolated copy
+                if isinstance(stmt, ast.If):
+                    body_seen = dict(seen)
+                    self._walk(stmt.body, body_seen)
+                    else_seen = dict(seen)
+                    self._walk(stmt.orelse, else_seen)
+                    # keep only facts every arm agrees on
+                    seen.clear()
+                    seen.update(
+                        {
+                            k: v
+                            for k, v in body_seen.items()
+                            if else_seen.get(k) == v
+                        }
+                    )
+                else:
+                    for sub_body in ("body", "orelse", "finalbody"):
+                        self._walk(getattr(stmt, sub_body, []) or [], seen)
+                    for handler in getattr(stmt, "handlers", []):
+                        self._walk(handler.body, seen)
+            elif isinstance(stmt, (ast.Expr, ast.Return, ast.Assert, ast.Raise)):
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self._walk_expr(child, seen)
+            elif isinstance(stmt, ast.FunctionDef):
+                self.scan(stmt)  # nested def: fresh scope
+
+
+def _root_of_text(expr_text: str) -> str:
+    return expr_text.split(".", 1)[0].split("[", 1)[0]
+
+
+@checker
+def check_rng(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # -- RTL402: entropy-seeded keys ---------------------------------------
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if _random_fn(name) not in ("PRNGKey", "key"):
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if (
+                    isinstance(sub, ast.Call)
+                    and dotted_name(sub.func) in BAD_SEED_CALLS
+                ):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            "RTL402",
+                            f"PRNG key seeded from {dotted_name(sub.func)}() — "
+                            "unreproducible; take the seed from config",
+                        )
+                    )
+
+    # -- RTL401: double consumption per function scope ---------------------
+    # Scan only outermost functions: _walk recurses into nested defs itself
+    # (with a fresh scope), so scanning them again would duplicate findings.
+    nested: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if sub is not node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested.add(id(sub))
+    scanner = _FnScanner(ctx)
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and id(node) not in nested
+        ):
+            scanner.scan(node)
+    findings.extend(scanner.findings)
+    return findings

@@ -94,6 +94,12 @@ class TrainingConfig:
     max_train_tokens: Optional[Any] = None  # accepts "100M"/"1B"
 
     # --- eval / save ---
+    # Metric-materialization cadence: device metrics from the last
+    # `log_every` updates are pulled to the host in ONE bulk transfer
+    # (metrics stay lagged by at least one step either way).  Raising this
+    # trades NaN-abort latency (the check runs on materialized values) for
+    # fewer host round trips.
+    log_every: int = 1
     eval_every: int = 1_000
     save_every: int = 10_000
     save_dir: Optional[str] = None
@@ -266,6 +272,8 @@ class TrainingConfig:
                 f"got {self.remat_policy!r}"
             )
 
+        if self.log_every < 1:
+            raise ValueError("log_every must be >= 1")
         if self.save_retries < 0:
             raise ValueError("save_retries must be >= 0")
         if self.spike_threshold < 0:

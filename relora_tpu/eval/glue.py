@@ -13,6 +13,7 @@ extra dependencies are needed.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -188,7 +189,7 @@ def finetune(
     tx = optax.adamw(lr_fn, weight_decay=gcfg.weight_decay)
     opt_state = tx.init(params)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, ids, labels, rng):
         def loss_fn(p):
             logits = model.apply({"params": p}, ids, deterministic=False, rngs={"dropout": rng})

@@ -149,11 +149,13 @@ class ContinuousBatchingScheduler:
                 cache, jnp.asarray(tokens)[:, None], jnp.asarray(positions)[:, None]
             )
             self._step_count += 1
-            next_tokens = self._sample_rows(logits, slots)
+            # one bulk pull for the whole batch, then plain Python ints —
+            # per-slot int(next_tokens[i]) would be a device sync per row
+            next_tokens = self._sample_rows(logits, slots).tolist()
             for slot_idx, slot in enumerate(slots):
                 if slot is None:
                     continue
-                tok = int(next_tokens[slot_idx])
+                tok = next_tokens[slot_idx]
                 slot.tokens.append(tok)
                 slot.pos += 1
                 tokens[slot_idx] = tok

@@ -70,6 +70,28 @@ _DTYPES = {
 }
 
 
+#: metric keys materialized as ints (counts), everything else as floats
+_INT_METRICS = frozenset({"skipped", "n_skipped"})
+
+
+def _pull_metric_records(metric_dicts):
+    """Materialize a batch of per-step device metric dicts in ONE bulk
+    device->host transfer and return plain-Python records.
+
+    This is the sanctioned landing spot for train-loop host syncs (see
+    docs/static-analysis.md, RTL2xx): the fit loop accumulates device-side
+    metric dicts for ``log_every`` updates and pays a single blocking round
+    trip here, instead of one ``float()`` per metric per step inside the
+    hot loop.  Values come back as Python floats (counts as ints) so the
+    logging code downstream never touches a device array.
+    """
+    host = jax.device_get(list(metric_dicts))
+    return [
+        {k: (int(v) if k in _INT_METRICS else float(v)) for k, v in d.items()}
+        for d in host
+    ]
+
+
 def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingConfig):
     compute_dtype = _DTYPES[cfg.dtype]
     if cfg.sp_size > 1:
@@ -535,52 +557,56 @@ class Trainer:
         # current step's device metrics would block the host on the step's
         # completion every iteration (costly through a TPU tunnel); by
         # logging the previous step's metrics while the current one computes,
-        # data loading and logging overlap device work.  The NaN-abort check
-        # therefore also lags one update — one extra step before an abort is
-        # harmless.
-        pending = None  # (metrics, update_step, global_step)
+        # data loading and logging overlap device work.  With
+        # cfg.log_every > 1 the lag grows to at most log_every updates and
+        # all lagged records are pulled in ONE bulk transfer
+        # (_pull_metric_records).  The NaN-abort check runs on materialized
+        # values, so it lags by the same bound — a few extra steps before an
+        # abort is harmless.
+        pending: list = []  # (metrics, update_step, global_step, tokens, dt, counters)
 
         def flush_pending() -> bool:
-            """Log the lagged metrics; returns False if training must abort."""
-            nonlocal pending, spike
-            if pending is None:
+            """Log all lagged metric records; returns False if training must
+            abort.  One bulk device pull for the whole batch — keep
+            float()/int() on device values out of here (RTL202)."""
+            nonlocal spike
+            if not pending:
                 return True
-            metrics, at_step, at_global, tokens_in_update, dt, counters = pending
-            pending = None
-            if float(metrics["skipped"]):
-                logger.error(
-                    f"NaN update skipped at step {at_step} "
-                    f"({int(metrics['n_skipped'])} total)"
-                )
-                self.metrics.event(
-                    "nan_skip", step=at_step, n_skipped=int(metrics["n_skipped"])
-                )
-                if int(metrics["n_skipped"]) > cfg.nan_abort_fraction * cfg.num_training_steps:
-                    logger.error("More than 5% of updates NaN-skipped; aborting")
-                    return False
-            loss_val = faults.perturb("loss", float(metrics["loss"]), step=at_step)
-            if detector is not None and spike is None:
-                spike = detector.update(at_step, loss_val)
-            record = {
-                "loss": loss_val,
-                "lr": float(metrics.get("lr", 0.0)),
-                "update_step": at_step,
-                "tokens_seen": self.tokens_seen,
-                "grad_norm": float(metrics["grad_norm"]),
-                "throughput_tokens": tokens_in_update / dt,
-                "throughput_examples": cfg.total_batch_size / dt,
-                "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
-                # snapshotted when the record was created, so counts attribute
-                # to the update they happened at despite the one-step lag
-                **counters,
-            }
-            # extra device metrics (grad_norm/* breakdown, lora_scaling, ...)
-            for k, v in metrics.items():
-                if k not in record and k not in ("skipped", "n_skipped"):
-                    record[k] = float(v)
-            self.metrics.log(record, step=at_global)
-            if prof is not None:
-                prof.step()
+            records = _pull_metric_records([p[0] for p in pending])
+            batch = [(m, *rest) for m, (_, *rest) in zip(records, pending)]
+            pending.clear()
+            for metrics, at_step, at_global, tokens_in_update, dt, counters in batch:
+                if metrics["skipped"]:
+                    logger.error(
+                        f"NaN update skipped at step {at_step} "
+                        f"({metrics['n_skipped']} total)"
+                    )
+                    self.metrics.event(
+                        "nan_skip", step=at_step, n_skipped=metrics["n_skipped"]
+                    )
+                    if metrics["n_skipped"] > cfg.nan_abort_fraction * cfg.num_training_steps:
+                        logger.error("More than 5% of updates NaN-skipped; aborting")
+                        return False
+                loss_val = faults.perturb("loss", metrics["loss"], step=at_step)
+                if detector is not None and spike is None:
+                    spike = detector.update(at_step, loss_val)
+                record = {
+                    "loss": loss_val,
+                    "lr": metrics.get("lr", 0.0),
+                    "update_step": at_step,
+                    "grad_norm": metrics["grad_norm"],
+                    "throughput_tokens": tokens_in_update / dt,
+                    "throughput_examples": cfg.total_batch_size / dt,
+                    "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
+                    # snapshotted when the record was created, so counts
+                    # attribute to the update they happened at despite the lag
+                    **counters,
+                }
+                # extra metrics (grad_norm/* breakdown, lora_scaling, ...)
+                for k, v in metrics.items():
+                    if k not in record and k not in ("skipped", "n_skipped"):
+                        record[k] = v
+                self.metrics.log(record, step=at_global)
             return True
 
         if self.update_step >= cfg.num_training_steps:
@@ -723,8 +749,11 @@ class Trainer:
                             f"LR after reset is {lr_now} > max {self.cfg.lr}",
                         )
 
-                # ---- metrics (torchrun_main.py:918-943), one-step lagged -
-                if not flush_pending():
+                # ---- metrics (torchrun_main.py:918-943), lagged ---------
+                # flush BEFORE appending: with log_every=1 this is exactly
+                # the historical one-step lag; larger values batch up to
+                # log_every records into one device pull
+                if len(pending) >= cfg.log_every and not flush_pending():
                     exhausted = False
                     aborted = True
                     break
@@ -732,17 +761,23 @@ class Trainer:
                 update_start = time.time()
                 tokens_in_update = self.tokens_seen - self.tokens_seen_before
                 self.tokens_seen_before = self.tokens_seen
-                pending = (
-                    metrics,
-                    self.update_step,
-                    self.global_step,
-                    tokens_in_update,
-                    update_time,
-                    {
-                        "n_lora_restarts": self.n_lora_restarts,
-                        "n_optimizer_resets": self.n_optimizer_resets,
-                    },
+                pending.append(
+                    (
+                        metrics,
+                        self.update_step,
+                        self.global_step,
+                        tokens_in_update,
+                        update_time,
+                        {
+                            "tokens_seen": self.tokens_seen,
+                            "n_lora_restarts": self.n_lora_restarts,
+                            "n_optimizer_resets": self.n_optimizer_resets,
+                        },
+                    )
                 )
+                if prof is not None:
+                    # per update step, regardless of the flush cadence
+                    prof.step()
 
                 # ---- loss-spike rollback --------------------------------
                 if spike is not None:
@@ -752,9 +787,9 @@ class Trainer:
                     )
                     detector.reset_streak()
                     if rolled_back:
-                        # drop the post-spike step's lagged metrics — the
-                        # step it describes was just undone
-                        pending = None
+                        # drop the lagged metric records — the steps they
+                        # describe were just undone
+                        pending.clear()
                         restart = True
                         exhausted = False
                         break
@@ -780,7 +815,7 @@ class Trainer:
             "aborted": aborted,
             "preempted": preempted,
             "n_rollbacks": self.n_spike_rollbacks,
-            "n_skipped": int(self.state.n_skipped),
+            "n_skipped": int(self.state.n_skipped),  # noqa: RTL202 - once, after the loop
         }
         if eval_iter_factory is not None and not preempted:
             final_loss, final_tokens = self.evaluate(
@@ -836,8 +871,9 @@ class Trainer:
                     ]
                 )
             )
-            loss_sum += float(sums[0])
-            n_tokens += float(sums[1])
+            s_loss, s_tok = sums.tolist()  # host array -> plain floats
+            loss_sum += s_loss
+            n_tokens += s_tok
             pending.clear()
             if np.isnan(loss_sum):
                 raise RuntimeError("NaN in evaluation loss")
@@ -850,9 +886,9 @@ class Trainer:
             # n_tokens is a global sum over hosts, each feeding an
             # equally-shaped local slice, so scale by process_count to keep
             # the host-side estimate an upper bound on the global count.
-            shape = np.asarray(arr).shape
+            shape = np.shape(arr)  # host-side metadata, no device transfer
             expected_tokens += (
-                int(shape[0] * max(shape[-1] - 1, 1)) * jax.process_count()
+                shape[0] * max(shape[-1] - 1, 1) * jax.process_count()
             )
             if len(pending) >= max(sync_every, 1) or (
                 target_tokens > 0 and expected_tokens >= target_tokens

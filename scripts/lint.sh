@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Static-analysis gate: the RTL footgun linter over the package.
+# Stdlib-only (no jax import), so it runs in any bare Python.
+#
+#   scripts/lint.sh            # lint relora_tpu/ against the baseline
+#   scripts/lint.sh path ...   # lint specific files/dirs
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec python -m relora_tpu.analysis "$@"

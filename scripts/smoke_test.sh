@@ -10,6 +10,10 @@ cd "$(dirname "$0")/.."
 WORK="${1:-$(mktemp -d /tmp/relora_smoke.XXXX)}"
 echo "workdir: $WORK"
 
+echo "=== 0. static analysis (relora-lint) ==="
+# cheapest gate first: stdlib-only AST lint, fails on new RTL findings
+bash scripts/lint.sh
+
 python - "$WORK" <<'EOF'
 import sys, numpy as np
 from relora_tpu.data.memmap import MemmapTokenWriter, best_dtype

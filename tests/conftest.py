@@ -19,7 +19,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: OFF by default.  On this jaxlib (0.4.37,
+# Persistent compilation cache: OFF by default (operator-facing writeup:
+# docs/operations.md §9 "Troubleshooting").  On this jaxlib (0.4.37,
 # CPU backend) executables deserialized from the persistent cache corrupt
 # the heap when combined with donate_argnums — runs that resume a second
 # Trainer in the same process die with "double free or corruption" / NaN

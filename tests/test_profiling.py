@@ -1,0 +1,90 @@
+"""Unit tests for utils/profiling.py: trace-window cadence, start/stop
+pairing, repeat budget, and the --profile gate.
+
+jax.profiler.start_trace/stop_trace are monkeypatched to event recorders —
+no real traces; these tests run in milliseconds.
+"""
+
+import dataclasses
+
+import pytest
+
+from relora_tpu.utils import profiling
+from relora_tpu.utils.profiling import StepProfiler, maybe_make_profiler
+
+
+@pytest.fixture()
+def events(monkeypatch):
+    log: list = []
+    monkeypatch.setattr(
+        profiling.jax.profiler, "start_trace", lambda d: log.append(("start", d))
+    )
+    monkeypatch.setattr(
+        profiling.jax.profiler, "stop_trace", lambda: log.append(("stop", None))
+    )
+    return log
+
+
+def kinds(events):
+    return [k for k, _ in events]
+
+
+def test_schedule_cadence(events, tmp_path):
+    # wait=1, warmup=1, active=2: trace covers steps 2-3 of each 4-step cycle
+    prof = StepProfiler(str(tmp_path), wait=1, warmup=1, active=2, repeat=2)
+    for _ in range(8):
+        prof.step()
+    assert kinds(events) == ["start", "stop", "start", "stop"]
+    assert events[0][1] == str(tmp_path)
+
+
+def test_start_stop_always_paired(events, tmp_path):
+    prof = StepProfiler(str(tmp_path), wait=0, warmup=0, active=1, repeat=3)
+    for _ in range(50):
+        prof.step()
+    prof.stop()
+    seq = kinds(events)
+    # never two starts without a stop between, and never a dangling trace
+    depth = 0
+    for k in seq:
+        depth += 1 if k == "start" else -1
+        assert depth in (0, 1)
+    assert depth == 0
+
+
+def test_repeat_budget_caps_traces(events, tmp_path):
+    prof = StepProfiler(str(tmp_path), wait=1, warmup=1, active=1, repeat=2)
+    for _ in range(100):
+        prof.step()
+    assert kinds(events).count("start") == 2  # budget spent, then inert
+
+
+def test_stop_mid_window_closes_trace(events, tmp_path):
+    prof = StepProfiler(str(tmp_path), wait=0, warmup=0, active=5, repeat=1)
+    prof.step()  # opens the trace window
+    assert kinds(events) == ["start"]
+    prof.stop()  # e.g. training aborted mid-window
+    assert kinds(events) == ["start", "stop"]
+    prof.stop()  # idempotent
+    assert kinds(events) == ["start", "stop"]
+
+
+def test_maybe_make_profiler_gate(events, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    @dataclasses.dataclass
+    class Cfg:
+        profile: bool = False
+
+    assert maybe_make_profiler(Cfg(profile=False)) is None
+    assert maybe_make_profiler(object()) is None  # no attribute at all
+    prof = maybe_make_profiler(Cfg(profile=True), run_name="r1")
+    assert isinstance(prof, StepProfiler)
+    assert prof.log_dir.endswith("profiler_logs/r1".replace("/", profiling.os.sep))
+
+
+def test_disabled_profiler_never_touches_jax(events, tmp_path):
+    # profile=False -> None -> the trainer's `if prof is not None` guards
+    # mean zero profiler calls; nothing must have been recorded
+    assert maybe_make_profiler(type("C", (), {"profile": False})()) is None
+    assert events == []

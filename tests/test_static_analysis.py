@@ -1,0 +1,574 @@
+"""Tests for relora_tpu.analysis — the RTL footgun linter.
+
+Per rule: a bad fixture that must fire and the corrected idiom that must
+stay quiet.  Plus suppression (# noqa), baseline round-trip, and the repo
+self-check (the tree lints clean against the checked-in baseline, with no
+stale entries).
+
+Pure stdlib — no jax import, no devices; these run anywhere, fast.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from relora_tpu.analysis import (
+    RULE_CATALOG,
+    BaselineEntry,
+    Finding,
+    format_baseline_entry,
+    lint_paths,
+    lint_text,
+    load_baseline,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def codes(src: str, *, hot: bool = False) -> list:
+    return [f.code for f in lint_text(textwrap.dedent(src), force_hot=hot)]
+
+
+# ---------------------------------------------------------------------------
+# RTL1xx retrace hazards
+
+
+def test_rtl101_branch_on_tracer_fires():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """
+    assert "RTL101" in codes(src)
+
+
+def test_rtl101_clean_where_idiom():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.where(x > 0, x, -x)
+    """
+    assert codes(src) == []
+
+
+def test_rtl101_static_shape_checks_ok():
+    # shape/ndim/isinstance/None-checks on traced args are host-static
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if x.ndim == 2:
+                x = x[None]
+            if mask is None:
+                return x
+            if isinstance(mask, tuple):
+                mask = mask[0]
+            return x * mask
+    """
+    assert codes(src) == []
+
+
+def test_rtl102_unhashable_static_arg_fires():
+    src = """
+        import jax
+
+        def f(x, sizes):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def caller(x):
+            return g(x, [1, 2, 3])
+    """
+    assert "RTL102" in codes(src)
+
+
+def test_rtl102_tuple_static_arg_ok():
+    src = """
+        import jax
+
+        def f(x, sizes):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def caller(x):
+            return g(x, (1, 2, 3))
+    """
+    assert codes(src) == []
+
+
+def test_rtl103_jit_inside_loop_fires():
+    src = """
+        import jax
+
+        def run(fns, x):
+            for fn in fns:
+                x = jax.jit(fn)(x)
+            return x
+    """
+    assert "RTL103" in codes(src)
+
+
+def test_rtl103_jit_hoisted_ok():
+    src = """
+        import jax
+
+        def run(fn, xs):
+            fast = jax.jit(fn)
+            for x in xs:
+                x = fast(x)
+            return x
+    """
+    assert codes(src) == []
+
+
+def test_rtl104_fstring_on_tracer_fires():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            print(f"x is {x}")
+            return x
+    """
+    assert "RTL104" in codes(src)
+
+
+def test_rtl104_debug_print_ok():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.debug.print("x is {}", x)
+            return x
+    """
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RTL2xx host syncs (hot regions; force_hot marks the fixture hot)
+
+
+def test_rtl201_item_fires_hot_only():
+    src = """
+        def loop(xs):
+            total = 0.0
+            for x in xs:
+                total += x.mean().item()
+            return total
+    """
+    assert "RTL201" in codes(src, hot=True)
+    assert codes(src, hot=False) == []  # same code cold: no finding
+
+
+def test_rtl202_float_on_computed_fires():
+    src = """
+        def loop(metrics):
+            return float(metrics["loss"])
+    """
+    assert "RTL202" in codes(src, hot=True)
+
+
+def test_rtl202_static_scalars_ok():
+    src = """
+        import time
+
+        def loop(batch, dt):
+            n = int(batch.size)
+            t = float(time.monotonic())
+            return n, t, float(dt)
+    """
+    assert codes(src, hot=True) == []
+
+
+def test_rtl203_block_until_ready_fires():
+    src = """
+        import jax
+
+        def loop(state):
+            jax.block_until_ready(state.params)
+    """
+    assert "RTL203" in codes(src, hot=True)
+
+
+def test_rtl204_np_asarray_fires_jnp_ok():
+    bad = """
+        import numpy as np
+
+        def loop(x):
+            return np.asarray(x)
+    """
+    good = """
+        import jax.numpy as jnp
+
+        def loop(x):
+            return jnp.asarray(x)  # host->device: fine
+    """
+    assert "RTL204" in codes(bad, hot=True)
+    assert codes(good, hot=True) == []
+
+
+def test_hot_marker_comment_activates_rules():
+    src = """
+        # relora-lint: hot-path
+
+        def loop(x):
+            return x.item()
+    """
+    assert "RTL201" in codes(src)
+
+
+# ---------------------------------------------------------------------------
+# RTL3xx donation
+
+
+def test_rtl301_read_after_donation_fires():
+    src = """
+        import jax
+
+        def make(step):
+            step_fn = jax.jit(step, donate_argnums=(0,))
+
+            def run(state, batch):
+                new_state, metrics = step_fn(state, batch)
+                return new_state, state.step  # donated buffer read
+            return run
+    """
+    assert "RTL301" in codes(src)
+
+
+def test_rtl301_rebind_ok():
+    src = """
+        import jax
+
+        def make(step):
+            step_fn = jax.jit(step, donate_argnums=(0,))
+
+            def run(state, batch):
+                state, metrics = step_fn(state, batch)
+                return state, state.step
+            return run
+    """
+    assert codes(src) == []
+
+
+def test_rtl301_loop_reuse_fires():
+    # donated on iteration 1, passed again on iteration 2
+    src = """
+        import jax
+
+        def make(step):
+            step_fn = jax.jit(step, donate_argnums=(0,))
+
+            def run(state, batches):
+                for b in batches:
+                    new_state = step_fn(state, b)
+                return new_state
+            return run
+    """
+    assert "RTL301" in codes(src)
+
+
+def test_rtl301_donation_is_function_scoped():
+    # two sibling functions binding the same name: one donates, one doesn't.
+    # the non-donating one must not inherit the other's donate_argnums.
+    src = """
+        import jax
+
+        def donating(step, state, batch):
+            step = jax.jit(step, donate_argnums=0)
+            new_state, m = step(state, batch)
+            return new_state
+
+        def plain(step, state, batch):
+            step = jax.jit(step)
+            new_state, m = step(state, batch)
+            return new_state, state.step  # fine: nothing was donated
+    """
+    assert codes(src) == []
+
+
+def test_rtl302_missing_donation_fires():
+    src = """
+        import jax
+
+        def step(state, batch):
+            return state
+
+        step_fn = jax.jit(step)
+    """
+    assert "RTL302" in codes(src)
+
+
+def test_rtl302_decorated_def_fires():
+    src = """
+        import jax
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+    """
+    assert "RTL302" in codes(src)
+
+
+def test_rtl302_with_donation_ok():
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+
+        def step(state, batch):
+            return state
+
+        step_fn = jax.jit(step, donate_argnums=(0,))
+    """
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RTL4xx RNG hygiene
+
+
+def test_rtl401_key_reuse_fires():
+    src = """
+        import jax
+
+        def init(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.normal(key, (4,))
+            return a, b
+    """
+    assert "RTL401" in codes(src)
+
+
+def test_rtl401_split_ok():
+    src = """
+        import jax
+
+        def init(key):
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, (4,))
+            key, sub = jax.random.split(key)
+            b = jax.random.normal(sub, (4,))
+            return a, b
+    """
+    assert codes(src) == []
+
+
+def test_rtl401_exclusive_branches_ok():
+    # one consumption per runtime path is fine
+    src = """
+        import jax
+
+        def draw(key, uniform):
+            if uniform:
+                return jax.random.uniform(key, (4,))
+            else:
+                return jax.random.normal(key, (4,))
+    """
+    assert codes(src) == []
+
+
+def test_rtl402_time_seed_fires():
+    src = """
+        import time
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(int(time.time()))
+    """
+    assert "RTL402" in codes(src)
+
+
+def test_rtl402_config_seed_ok():
+    src = """
+        import jax
+
+        def make_key(cfg):
+            return jax.random.PRNGKey(cfg.seed)
+    """
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RTL5xx pytree / sharding
+
+
+def test_rtl501_inplace_params_mutation_fires():
+    src = """
+        def graft(params, new_head):
+            params["lm_head"] = new_head
+            return params
+    """
+    assert "RTL501" in codes(src)
+
+
+def test_rtl501_dict_mutator_fires():
+    src = """
+        def prune(params, name):
+            params.pop(name)
+            return params
+    """
+    assert "RTL501" in codes(src)
+
+
+def test_rtl501_rebuild_or_rebind_ok():
+    src = """
+        def graft(params, new_head):
+            return {**params, "lm_head": new_head}
+
+        def prune(params, name):
+            params = dict(params)
+            params.pop(name)
+            return params
+    """
+    assert codes(src) == []
+
+
+def test_rtl502_specless_shard_map_fires():
+    src = """
+        from jax.experimental.shard_map import shard_map
+
+        def wrap(f, mesh):
+            return shard_map(f, mesh)
+    """
+    assert "RTL502" in codes(src)
+
+
+def test_rtl502_explicit_specs_ok():
+    src = """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def wrap(f, mesh):
+            return shard_map(f, mesh, in_specs=(P("dp"),), out_specs=P("dp"))
+    """
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# engine: catalog, suppression, baseline, CLI, repo self-check
+
+
+def test_catalog_covers_all_families():
+    assert len(RULE_CATALOG) >= 10
+    families = {code[:4] for code in RULE_CATALOG}
+    assert families == {"RTL1", "RTL2", "RTL3", "RTL4", "RTL5"}
+
+
+def test_noqa_suppresses_specific_and_bare():
+    src = """
+        def graft(params, new_head):
+            params["lm_head"] = new_head  # noqa: RTL501
+            return params
+
+        def graft2(params, new_head):
+            params["lm_head"] = new_head  # noqa
+            return params
+
+        def graft3(params, new_head):
+            params["lm_head"] = new_head  # noqa: RTL101
+            return params
+    """
+    found = lint_text(textwrap.dedent(src))
+    # first two suppressed; the wrong-code noqa does not suppress
+    assert [f.code for f in found] == ["RTL501"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = Finding("pkg/mod.py", 3, "RTL501", "msg", 'params["x"] = y')
+    line = format_baseline_entry(f, "intentional: grafting owns the dict")
+    p = tmp_path / "baseline.txt"
+    p.write_text("# comment\n\n" + line + "\n")
+    entries = load_baseline(str(p))
+    assert len(entries) == 1 and entries[0].matches(f)
+    # different line text (the code changed) no longer matches
+    assert not entries[0].matches(
+        Finding("pkg/mod.py", 3, "RTL501", "msg", 'params["y"] = y')
+    )
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "baseline.txt"
+    p.write_text("a.py | RTL501 | x = 1 |\n")
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+def test_lint_paths_baseline_and_stale(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f(params, v):\n    params['k'] = v\n    return params\n")
+    baseline = [
+        BaselineEntry("mod.py", "RTL501", "params['k'] = v", "ok", 1),
+        BaselineEntry("mod.py", "RTL101", "gone", "stale entry", 2),
+    ]
+    report = lint_paths([str(mod)], root=str(tmp_path), baseline=baseline)
+    assert report.new == []
+    assert report.baselined == 1
+    assert [e.lineno for e in report.stale_baseline] == [2]
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(params, v):\n    params['k'] = v\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(params, v):\n    return {**params, 'k': v}\n")
+    env_root = str(REPO_ROOT)
+
+    r = subprocess.run(
+        [sys.executable, "-m", "relora_tpu.analysis", "--no-baseline", str(bad)],
+        capture_output=True,
+        text=True,
+        cwd=env_root,
+    )
+    assert r.returncode == 1
+    assert "RTL501" in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, "-m", "relora_tpu.analysis", "--no-baseline", str(clean)],
+        capture_output=True,
+        text=True,
+        cwd=env_root,
+    )
+    assert r.returncode == 0
+    assert r.stdout == ""
+
+
+def test_repo_lints_clean_against_baseline():
+    """The tree itself must pass: no new findings, no stale baseline rows,
+    no parse errors.  This is the tier-1 lint gate."""
+    report = lint_paths(
+        [str(REPO_ROOT / "relora_tpu")],
+        root=str(REPO_ROOT),
+        baseline=str(REPO_ROOT / "tools" / "lint_baseline.txt"),
+    )
+    assert report.parse_errors == []
+    assert [f.render() for f in report.new] == []
+    assert [e.path + "|" + e.code for e in report.stale_baseline] == []
+    # the linter actually ran over the package, not an empty dir
+    assert report.files_scanned > 40
+
+
+def test_repo_baseline_entries_are_justified():
+    entries = load_baseline(str(REPO_ROOT / "tools" / "lint_baseline.txt"))
+    assert entries, "baseline exists and has entries"
+    for e in entries:
+        assert len(e.justification) > 10, f"thin justification: {e}"

@@ -49,7 +49,8 @@ def measure_memory(seq: int, ring: int, tiles, heads: int, kv_heads: int, head_d
     args = tuple(jax.device_put(x, spec) for x in (q, k, v))
 
     for tile in tiles:
-        fn = jax.jit(
+        # sweep: each iteration compiles a DIFFERENT tile config on purpose
+        fn = jax.jit(  # noqa: RTL103
             lambda a, b, c, t=tile: ring_attention(a, b, c, mesh, causal=True, tile=t)
         )
         mem = fn.lower(*args).compile().memory_analysis()
@@ -93,7 +94,7 @@ def measure_throughput(seq: int, tiles, heads: int, kv_heads: int, head_dim: int
                 ring_attention(a, b, c, mesh, causal=True, tile=t).astype(jnp.float32) ** 2
             )
 
-        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))  # noqa: RTL103 - per-tile sweep
         out = step(q, k, v)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Repo lint entry point — thin wrapper over ``python -m relora_tpu.analysis``.
+
+Exists so CI configs and editors can point at a stable script path; all
+behavior (rules, baseline, exit codes) lives in relora_tpu.analysis.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd without an installed package
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from relora_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
