@@ -32,6 +32,14 @@ Configured by env: BENCH_SERVE_MODEL (default llama_250m), BENCH_SERVE_BATCH,
 BENCH_SERVE_PROMPT_LEN, BENCH_SERVE_NEW_TOKENS.  Runs on whatever backend is
 up — CPU included — so it carries no probe/stale-fallback machinery; the
 device lands in the artifact for the reader to judge.
+
+``--mode lora_kernel`` times the three execution arms of the LoRA composite
+``x@W + ((x@A)@B)*s`` (fused pallas / ordered-unfused / merged — see
+relora_tpu/ops/lora_dispatch) per shape bucket, written to
+``BENCH_lora.json``.  Env: BENCH_LORA_SHAPES ("M:K:N,..."), BENCH_LORA_RANKS,
+BENCH_LORA_ITERS, BENCH_LORA_DTYPE (f32|bf16).  Off-TPU the fused arm runs
+the pallas *interpreter* — orders of magnitude slower than XLA, reported for
+parity-debugging only; arm-vs-arm conclusions need the TPU run.
 """
 
 from __future__ import annotations
@@ -329,17 +337,107 @@ def decode_main() -> None:
     print(json.dumps(result))
 
 
+def lora_kernel_main() -> None:
+    """--mode lora_kernel: per-shape step time of the three LoRA composite
+    arms (fused pallas / ordered-unfused / merged), plus what the dispatch
+    cost model would pick.  Like --mode decode, runs on whatever backend is
+    up; off-TPU the fused arm is the interpreter (reported, but not a
+    performance claim — the artifact records the device)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from relora_tpu.ops.lora_dispatch import choose_arm, lora_matmul, plan_blocks
+
+    on_tpu = jax.default_backend() == "tpu"
+    # CPU-interpret fused arms are slow: default to small buckets off-TPU.
+    default_shapes = "8:2048:2048,512:2048:2048,4096:2048:2048" if on_tpu else (
+        "8:512:512,128:512:512,512:512:512"
+    )
+    shapes = [
+        tuple(int(v) for v in bucket.split(":"))
+        for bucket in os.environ.get("BENCH_LORA_SHAPES", default_shapes).split(",")
+    ]
+    ranks = [int(v) for v in os.environ.get("BENCH_LORA_RANKS", "8,128").split(",")]
+    iters = int(os.environ.get("BENCH_LORA_ITERS", "20" if on_tpu else "5"))
+    dtype_name = os.environ.get("BENCH_LORA_DTYPE", "bf16" if on_tpu else "f32")
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+
+    def time_arm(fn, *operands) -> float:
+        jax.block_until_ready(fn(*operands))  # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*operands)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    key = jax.random.PRNGKey(0)
+    buckets = []
+    for M, K, N in shapes:
+        for r in ranks:
+            ks = jax.random.split(jax.random.fold_in(key, M * 131 + r), 4)
+            x = jax.random.normal(ks[0], (M, K), dtype)
+            w = jax.random.normal(ks[1], (K, N), dtype)
+            a = jax.random.normal(ks[2], (K, r), dtype) * 0.01
+            b = jax.random.normal(ks[3], (r, N), dtype) * 0.01
+            scale = 0.25
+            row = {"M": M, "K": K, "N": N, "r": r,
+                   "planned_blocks": plan_blocks(M, N)}
+            for arm in ("fused", "ordered", "merged"):
+                fn = jax.jit(
+                    lambda x, w, a, b, _arm=arm: lora_matmul(
+                        x, w, a, b, scale, arm=_arm, dtype=dtype
+                    )
+                )
+                row[f"{arm}_ms"] = round(time_arm(fn, x, w, a, b) * 1e3, 4)
+            nbytes = jnp.dtype(dtype).itemsize
+            row["model_choice"] = choose_arm(
+                M, K, N, r, nbytes, nbytes, fused_available=on_tpu
+            )
+            row["measured_best"] = min(
+                ("fused", "ordered", "merged"), key=lambda arm: row[f"{arm}_ms"]
+            )
+            buckets.append(row)
+
+    top = buckets[-1]
+    result = {
+        "metric": f"fused LoRA kernel speedup vs unfused "
+        f"(M={top['M']} K={top['K']} N={top['N']} r={top['r']}, {dtype_name})",
+        "value": round(top["ordered_ms"] / top["fused_ms"], 4),
+        "unit": "x",
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "backend": jax.default_backend(),
+            "fused_is_interpret": not on_tpu,
+            "dtype": dtype_name,
+            "iters": iters,
+            "buckets": buckets,
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_lora.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
     import argparse
 
     _ap = argparse.ArgumentParser()
-    _ap.add_argument("--mode", choices=["train", "decode", "lint"], default="train")
+    _ap.add_argument(
+        "--mode", choices=["train", "decode", "lint", "lora_kernel"], default="train"
+    )
     _cli = _ap.parse_args()
     if _cli.mode == "lint":
         lint_main()
         sys.exit(0)
     if _cli.mode == "decode":
         decode_main()
+        sys.exit(0)
+    if _cli.mode == "lora_kernel":
+        lora_kernel_main()
         sys.exit(0)
     if os.environ.get("BENCH_FORCE") != "1":
         platform, err = _probe_device()

@@ -31,6 +31,11 @@ from relora_tpu.analysis.core import FileContext
 #: repo-relative path suffix -> hot function qualname prefixes ("" = whole file)
 HOT_FUNCTIONS: Dict[str, List[str]] = {
     "relora_tpu/train/step.py": [""],  # every step builder is jitted hot code
+    # kernel + dispatch modules: traced inside every LoRA linear, training
+    # and decode both — a host sync here hits once per layer per step
+    "relora_tpu/ops/pallas_lora_matmul.py": [""],
+    "relora_tpu/ops/lora_dispatch.py": [""],
+    "relora_tpu/ops/pallas_quant_matmul.py": [""],
     "relora_tpu/train/trainer.py": [
         "Trainer.fit",  # the update loop, including nested closures
         "Trainer._prefetched",

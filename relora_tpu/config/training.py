@@ -71,6 +71,11 @@ class TrainingConfig:
     lora_dropout: float = 0.1
     relora: Optional[int] = None  # merge-and-reinit every N update steps
     train_scaling: bool = False
+    # LoRA composite execution: "false" = historical unfused path, "true" =
+    # fused Pallas kernel (ops/pallas_lora_matmul), "auto" = per-shape
+    # dispatch (ops/lora_dispatch).  A string (not bool) so the CLI accepts
+    # "auto" — maps onto LoraSpec.fused.
+    lora_fused: str = "false"
     reset_optimizer_on_relora: bool = True
     optimizer_random_pruning: float = 0.0
     optimizer_magnitude_pruning: float = 0.0
@@ -262,6 +267,11 @@ class TrainingConfig:
 
         if self.quantize not in (None, "int8", "nf4"):
             raise ValueError(f"quantize must be None, 'int8' or 'nf4', got {self.quantize!r}")
+        if str(self.lora_fused).lower() not in ("false", "true", "auto"):
+            raise ValueError(
+                f"lora_fused must be 'false', 'true' or 'auto', got {self.lora_fused!r}"
+            )
+        self.lora_fused = str(self.lora_fused).lower()
         if self.base_dtype not in (None, "bf16"):
             raise ValueError(f"base_dtype must be None or 'bf16', got {self.base_dtype!r}")
         if self.base_dtype and self.quantize:

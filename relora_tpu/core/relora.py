@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Any, Optional, Tuple
+from typing import Any, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,20 @@ class LoraSpec:
     # relora.py:209-211; selected when neither relora, force_keep_original
     # nor a warm start needs the full kernel, torchrun_main.py:531-553)
     lora_only: bool = False
+    # How to execute the y = x@W + ((x@A)@B)*scale composite:
+    #   False  — the historical unfused path (three matmuls + add)
+    #   True   — always the fused Pallas kernel (ops/pallas_lora_matmul)
+    #            where shapes tile; untileable shapes fall back unfused
+    #   "auto" — per-shape choice between fused / unfused / merged via the
+    #            ops/lora_dispatch roofline cost model
+    # Replaces env-var gating: the value is part of the spec, read once at
+    # construction, so traced code never touches os.environ.
+    fused: Union[bool, str] = False
+    # Serving hint set by serve/engine.build_decode_model: W/A/B are constant
+    # across decode steps, so the dispatch cost model may treat the merged
+    # W + scale·A@B as amortized (it decides decode-shaped calls toward the
+    # merged arm).  Never set in training — W changes every update.
+    weights_static: bool = False
 
     def __post_init__(self):
         # validate HERE (not just TrainingConfig): bench.py/bench_sweep/
@@ -69,6 +83,8 @@ class LoraSpec:
             raise ValueError(f"base_dtype must be None or 'bf16', got {self.base_dtype!r}")
         if self.base_dtype and self.quantize:
             raise ValueError("base_dtype applies to the unquantized base; drop it or quantize")
+        if self.fused not in (True, False, "auto"):
+            raise ValueError(f"fused must be True, False or 'auto', got {self.fused!r}")
 
     @property
     def scale(self) -> float:

@@ -23,6 +23,7 @@ preserving the same init-equivalence guarantee.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -36,6 +37,13 @@ import logging
 # (module name, width) pairs already warned about the nf4->int8 fallback —
 # the warning should fire once per projection, not on every trace
 _NF4_FALLBACK_WARNED: set = set()
+
+
+def _env_pallas_quant() -> bool:
+    """RELORA_TPU_PALLAS_QUANT=1 opt-in, read at module *construction* —
+    never inside the traced ``__call__`` (the retrace footgun RTL1xx
+    polices: an env flip between traces would silently split the cache)."""
+    return os.environ.get("RELORA_TPU_PALLAS_QUANT") == "1"
 
 
 class LoRALinear(nn.Module):
@@ -54,6 +62,14 @@ class LoRALinear(nn.Module):
     kernel_init: nn.initializers.Initializer = nn.initializers.normal(stddev=0.02)
     kernel_axes: Tuple[Optional[str], Optional[str]] = (None, None)
     quantize: Optional[str] = None  # None | "int8" (frozen base only)
+    # Pallas dequant-matmul opt-in for the int8 base.  None = consult the
+    # RELORA_TPU_PALLAS_QUANT env var once, here at construction.
+    pallas_quant: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.pallas_quant is None:
+            object.__setattr__(self, "pallas_quant", _env_pallas_quant())
+        super().__post_init__()
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
@@ -84,86 +100,144 @@ class LoRALinear(nn.Module):
                     "storing this base as int8 (plan_memory accounts for it)",
                     in_features, self.name,
                 )
+        # Fused/dispatched composite: spec.fused routes the whole
+        # y = x@W + ((x@A)@B)*scale through ops/lora_dispatch instead of the
+        # three-matmul path below.  Dropout makes the branch input differ
+        # from the base input and nf4 has no fused kernel — both keep the
+        # historical path (the fallback matrix in docs/kernels.md).
+        dropout_active = (
+            self.lora is not None and self.lora.dropout > 0.0 and not deterministic
+        )
+        if (
+            self.lora is not None
+            and self.lora.fused in (True, "auto")
+            and quantize in (None, "int8")
+            and not dropout_active
+        ):
+            return self._dispatched(x, in_features, quantize)
         if quantize == "int8":
-            from relora_tpu.ops.quant import dequantize_int8
-
-            # Fresh init is W=0 (codes zero, scales one): a quantized base is
-            # only meaningful warm-started from real weights — exactly how the
-            # reference uses bitsandbytes (it quantizes the wrapped module's
-            # existing weight_data, relora.py:222-238).  Use
-            # hf_compat.graft_base_weights, which quantizes f32 sources on
-            # the fly.
-            def q_init(key, shape, dtype):
-                return jnp.zeros(shape, dtype)
-
-            def s_init(key, shape, dtype):
-                return jnp.ones(shape, dtype)
-
-            kernel_q = self.param(
-                "kernel_q",
-                nn.with_logical_partitioning(q_init, self.kernel_axes),
-                (in_features, self.features),
-                jnp.int8,
-            )
-            kernel_scale = self.param(
-                "kernel_scale",
-                nn.with_logical_partitioning(s_init, (None, self.kernel_axes[1])),
-                (1, self.features),
-                jnp.float32,
-            )
-            y = self._int8_matmul(x, kernel_q, kernel_scale, dequantize_int8)
+            kernel_q, kernel_scale = self._int8_params(in_features)
+            y = self._int8_matmul(x, kernel_q, kernel_scale)
         elif quantize == "nf4":
             y = self._nf4_matmul(x, in_features)
         elif quantize is not None:
             raise ValueError(f"Unknown quantize mode {quantize!r}")
         else:
-            # frozen-base storage dtype: spec.base_dtype == "bf16" drops the
-            # f32 master for the base kernel (it takes no per-step optimizer
-            # updates; merges cast back to storage dtype in core/relora.py).
-            # Only applies when the kernel IS a frozen LoRA base — a plain
-            # Dense (no LoRA spec) keeps the f32 master.
-            base_dtype = (
-                jnp.bfloat16
-                if (self.lora is not None and self.lora.base_dtype == "bf16")
-                else self.param_dtype
-            )
-            kernel = self.param(
-                "kernel",
-                nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
-                (in_features, self.features),
-                base_dtype,
-            )
+            kernel = self._dense_kernel(in_features)
             y = jnp.matmul(x.astype(self.dtype), kernel.astype(self.dtype))
         if self.use_bias:
-            bias = self.param(
-                "bias",
-                nn.with_logical_partitioning(nn.initializers.zeros_init(), (self.kernel_axes[1],)),
-                (self.features,),
-                self.param_dtype,
-            )
-            y = y + bias.astype(self.dtype)
+            y = y + self._bias_param().astype(self.dtype)
 
         if self.lora is not None:
             y = y + self._lora_branch(x, in_features, deterministic)
         return y
 
-    def _int8_matmul(self, x, kernel_q, kernel_scale, dequantize_int8) -> jax.Array:
+    # -- param definitions (shared by the historical and dispatched paths;
+    # flax params are name-keyed, so both paths see identical init values) --
+
+    def _dense_kernel(self, in_features: int) -> jax.Array:
+        # frozen-base storage dtype: spec.base_dtype == "bf16" drops the
+        # f32 master for the base kernel (it takes no per-step optimizer
+        # updates; merges cast back to storage dtype in core/relora.py).
+        # Only applies when the kernel IS a frozen LoRA base — a plain
+        # Dense (no LoRA spec) keeps the f32 master.
+        base_dtype = (
+            jnp.bfloat16
+            if (self.lora is not None and self.lora.base_dtype == "bf16")
+            else self.param_dtype
+        )
+        return self.param(
+            "kernel",
+            nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
+            (in_features, self.features),
+            base_dtype,
+        )
+
+    def _int8_params(self, in_features: int) -> Tuple[jax.Array, jax.Array]:
+        # Fresh init is W=0 (codes zero, scales one): a quantized base is
+        # only meaningful warm-started from real weights — exactly how the
+        # reference uses bitsandbytes (it quantizes the wrapped module's
+        # existing weight_data, relora.py:222-238).  Use
+        # hf_compat.graft_base_weights, which quantizes f32 sources on
+        # the fly.
+        def q_init(key, shape, dtype):
+            return jnp.zeros(shape, dtype)
+
+        def s_init(key, shape, dtype):
+            return jnp.ones(shape, dtype)
+
+        kernel_q = self.param(
+            "kernel_q",
+            nn.with_logical_partitioning(q_init, self.kernel_axes),
+            (in_features, self.features),
+            jnp.int8,
+        )
+        kernel_scale = self.param(
+            "kernel_scale",
+            nn.with_logical_partitioning(s_init, (None, self.kernel_axes[1])),
+            (1, self.features),
+            jnp.float32,
+        )
+        return kernel_q, kernel_scale
+
+    def _bias_param(self) -> jax.Array:
+        return self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), (self.kernel_axes[1],)),
+            (self.features,),
+            self.param_dtype,
+        )
+
+    def _dispatched(self, x: jax.Array, in_features: int, quantize: Optional[str]) -> jax.Array:
+        """The y = x@W + ((x@A)@B)*scale composite via ops/lora_dispatch.
+
+        ``fused=True`` pins the fused Pallas kernel (untileable shapes fall
+        back to the ordered reference inside the dispatcher); ``"auto"``
+        lets the roofline cost model pick per shape.  The frozen base gets
+        ``stop_gradient`` so every arm agrees its cotangent is zero — the
+        optimizer mask already never applies base updates, this just keeps
+        grads arm-independent.
+        """
+        from relora_tpu.ops.lora_dispatch import lora_matmul
+
+        if quantize == "int8":
+            kernel_q, kernel_scale = self._int8_params(in_features)
+            base = (kernel_q, kernel_scale)
+        else:
+            base = jax.lax.stop_gradient(
+                self._dense_kernel(in_features).astype(self.dtype)
+            )
+        lora_a, lora_b, scale = self._lora_factors(in_features)
+        y = lora_matmul(
+            x.astype(self.dtype),
+            base,
+            lora_a.astype(self.dtype),
+            lora_b.astype(self.dtype),
+            scale,
+            arm="fused" if self.lora.fused is True else "auto",
+            dtype=self.dtype,
+            weights_static=self.lora.weights_static,
+        )
+        if self.use_bias:
+            y = y + self._bias_param().astype(self.dtype)
+        return y
+
+    def _int8_matmul(self, x, kernel_q, kernel_scale) -> jax.Array:
         """x @ int8 base.  Default: dequantize then matmul (XLA fuses).
-        RELORA_TPU_PALLAS_QUANT=1 opts into the custom pallas kernel that
-        keeps the weight int8 into VMEM (ops/pallas_quant_matmul) when the
-        shapes tile; falls back silently otherwise."""
-        import os
-
-        if os.environ.get("RELORA_TPU_PALLAS_QUANT") == "1":
-            import numpy as np
-
+        ``pallas_quant`` (RELORA_TPU_PALLAS_QUANT=1, read at construction)
+        opts into the custom pallas kernel that keeps the weight int8 into
+        VMEM (ops/pallas_quant_matmul) when the shapes tile; falls back
+        otherwise."""
+        if self.pallas_quant:
+            from relora_tpu.ops.lora_dispatch import plan_blocks
             from relora_tpu.ops.pallas_quant_matmul import dequant_matmul
 
-            M = int(np.prod(x.shape[:-1]))
-            N = self.features
-            bm = next((b for b in (256, 128, 64, 32, 16, 8) if M % b == 0), None)
-            bn = next((b for b in (256, 128) if N % b == 0), None)
-            if bm and bn:
+            M = 1
+            for d in x.shape[:-1]:
+                M *= d
+            planned = plan_blocks(M, self.features)
+            if planned:
+                bm, bn = planned
                 lead = x.shape[:-1]
                 out = dequant_matmul(
                     x.reshape(M, x.shape[-1]).astype(self.dtype),
@@ -174,7 +248,9 @@ class LoRALinear(nn.Module):
                     interpret=jax.default_backend() == "cpu",
                     out_dtype=self.dtype,
                 )
-                return out.reshape(*lead, N)
+                return out.reshape(*lead, self.features)
+        from relora_tpu.ops.quant import dequantize_int8
+
         kernel = dequantize_int8(kernel_q, kernel_scale, self.dtype)
         return jnp.matmul(x.astype(self.dtype), kernel)
 
@@ -229,8 +305,10 @@ class LoRALinear(nn.Module):
         kernel = dequantize_nf4(leaves, self.dtype)
         return jnp.matmul(x.astype(self.dtype), kernel)
 
-    def _lora_branch(self, x: jax.Array, in_features: int, deterministic: bool) -> jax.Array:
-        """((dropout(x) @ A) @ B) * scale (parity: relora.py:309-323)."""
+    def _lora_factors(self, in_features: int):
+        """Define the LoRA leaves; returns (lora_a, lora_b, scale) where
+        scale is either the static spec.scale float or the traced
+        trainable-scaling ``tanh(lora_s)`` (parity: relora.py:263-267)."""
         spec = self.lora
         lora_a = self.param(
             "lora_a",
@@ -249,11 +327,6 @@ class LoRALinear(nn.Module):
             (spec.r, self.features),
             self.param_dtype,
         )
-        h = x
-        if spec.dropout > 0.0 and not deterministic:
-            h = nn.Dropout(rate=spec.dropout, deterministic=False)(h)
-        z = jnp.matmul(h.astype(self.dtype), lora_a.astype(self.dtype))
-        z = jnp.matmul(z, lora_b.astype(self.dtype))
         if spec.trainable_scaling:
             lora_s = self.param(
                 "lora_s", nn.initializers.ones_init(), (1,), self.param_dtype
@@ -262,4 +335,15 @@ class LoRALinear(nn.Module):
             scale = jnp.tanh(lora_s.astype(self.dtype))
         else:
             scale = spec.scale
+        return lora_a, lora_b, scale
+
+    def _lora_branch(self, x: jax.Array, in_features: int, deterministic: bool) -> jax.Array:
+        """((dropout(x) @ A) @ B) * scale (parity: relora.py:309-323)."""
+        spec = self.lora
+        lora_a, lora_b, scale = self._lora_factors(in_features)
+        h = x
+        if spec.dropout > 0.0 and not deterministic:
+            h = nn.Dropout(rate=spec.dropout, deterministic=False)(h)
+        z = jnp.matmul(h.astype(self.dtype), lora_a.astype(self.dtype))
+        z = jnp.matmul(z, lora_b.astype(self.dtype))
         return z * scale

@@ -19,11 +19,17 @@ TPU path is opt-in (RELORA_TPU_PALLAS_QUANT=1) until validated per-chip.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+# (M, K, N) shapes already warned about the unfused backward — the log should
+# fire once per shape at trace time, not on every step (same pattern as
+# models/lora._NF4_FALLBACK_WARNED)
+_BWD_FALLBACK_WARNED: set = set()
 
 
 def _dequant_matmul_kernel(x_ref, q_ref, scale_ref, out_ref):
@@ -71,6 +77,18 @@ def _dequant_matmul_fwd(bm, bn, interpret, out_dtype, x2, q, scale):
 
 def _dequant_matmul_bwd(bm, bn, interpret, out_dtype, res, g):
     x2, q, scale = res
+    key = (x2.shape[0], q.shape[0], q.shape[1])
+    if key not in _BWD_FALLBACK_WARNED:
+        # once per shape at trace time: the backward is NOT the fused int8
+        # kernel — it dequantizes and runs plain matmuls, so per-kernel
+        # benchmarks must not attribute the f32-traffic backward cost to the
+        # pallas forward (fused fwd+bwd lives in ops/pallas_lora_matmul)
+        _BWD_FALLBACK_WARNED.add(key)
+        logging.getLogger(__name__).info(
+            "dequant_matmul backward for (M=%d, K=%d, N=%d) takes the "
+            "dequantize-then-matmul fallback (pallas forward only)",
+            *key,
+        )
     g32 = g.astype(jnp.float32)
     w = q.astype(jnp.float32) * scale  # (K, N)
     dx = jnp.matmul(g32, w.T).astype(x2.dtype)

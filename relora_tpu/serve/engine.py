@@ -29,6 +29,7 @@ Without a mesh the same code runs single-host (CPU tests, dev boxes).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -38,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec
 from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, param_shardings
 from relora_tpu.serve.sampling import SamplingParams, sample
 
@@ -67,12 +69,26 @@ def build_decode_model(
     dtype=jnp.float32,
     scan_layers: bool = True,
     attention_impl: str = "auto",
+    lora: Optional[LoraSpec] = None,
 ):
     """The serving twin of train.trainer.build_model: same family dispatch,
-    LoRA-free (serve loads merged params), decode cache enabled, no remat."""
+    decode cache enabled, no remat.  ``lora=None`` (the default) serves a
+    merged, LoRA-free param tree; passing the checkpoint's ``LoraSpec``
+    serves the factors unmerged (quantized bases that can't absorb the
+    delta, or adapter hot-swap).  An unmerged spec is rewritten for decode:
+    ``weights_static`` tells ops/lora_dispatch's cost model that W/A/B are
+    constant across steps, and ``fused=False`` is promoted to ``"auto"`` so
+    the decode forward actually routes through the dispatcher — which picks
+    the merged ``x @ (W + s·A@B)`` arm at decode-sized M."""
+    if lora is not None:
+        lora = dataclasses.replace(
+            lora,
+            weights_static=True,
+            fused="auto" if lora.fused is False else lora.fused,
+        )
     kwargs = dict(
         config=model_cfg,
-        lora=None,
+        lora=lora,
         dtype=dtype,
         scan_layers=scan_layers,
         remat=False,
@@ -95,9 +111,10 @@ def build_decode_model(
 class InferenceEngine:
     """Owns the decode-mode model, the jitted step functions, and placement.
 
-    ``params`` must be a merged (LoRA-free) tree matching the training layout
-    (scan-stacked layers when ``scan_layers``) — see
-    train.checkpoint.restore_serving_params.
+    ``params`` must match the training layout (scan-stacked layers when
+    ``scan_layers``): a merged LoRA-free tree by default (see
+    train.checkpoint.restore_serving_params), or — with ``lora=`` set to the
+    checkpoint's spec — the raw tree with its LoRA factors still separate.
     """
 
     def __init__(
@@ -110,6 +127,7 @@ class InferenceEngine:
         scan_layers: bool = True,
         attention_impl: str = "auto",
         mesh: Optional[Mesh] = None,
+        lora: Optional[LoraSpec] = None,
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
@@ -122,6 +140,7 @@ class InferenceEngine:
             dtype=dtype,
             scan_layers=scan_layers,
             attention_impl=attention_impl,
+            lora=lora,
         )
         params = jax.tree_util.tree_map(jnp.asarray, params)
         if mesh is not None:

@@ -190,6 +190,7 @@ class Trainer:
                 use_double_quant=cfg.use_double_quant,
                 base_dtype=cfg.base_dtype,
                 lora_only=not need_linear_weight,
+                fused="auto" if cfg.lora_fused == "auto" else cfg.lora_fused == "true",
             )
             if cfg.use_peft
             else None

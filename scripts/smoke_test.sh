@@ -14,6 +14,33 @@ echo "=== 0. static analysis (relora-lint) ==="
 # cheapest gate first: stdlib-only AST lint, fails on new RTL findings
 bash scripts/lint.sh
 
+echo "=== 0b. fused LoRA kernel parity (interpret mode) ==="
+# the fused pallas composite vs the unfused reference, forward and grads,
+# on the CPU interpreter — catches kernel regressions before any training
+python - <<'EOF'
+import jax, jax.numpy as jnp
+from relora_tpu.ops.lora_dispatch import lora_matmul
+from relora_tpu.ops.quant import quantize_int8
+
+k = jax.random.PRNGKey(0)
+M, K, N, r = 32, 256, 128, 8
+x = jax.random.normal(jax.random.fold_in(k, 1), (M, K), jnp.float32)
+w = jax.random.normal(jax.random.fold_in(k, 2), (K, N), jnp.float32)
+a = jax.random.normal(jax.random.fold_in(k, 3), (K, r), jnp.float32) * 0.1
+b = jax.random.normal(jax.random.fold_in(k, 4), (r, N), jnp.float32) * 0.1
+ref = lambda x, a, b: x @ w + (x @ a) @ b * 0.25
+for base, tag in ((w, "dense"), (quantize_int8(w), "int8")):
+    wd = base if tag == "dense" else base[0].astype(jnp.float32) * base[1]
+    refd = lambda x, a, b, wd=wd: x @ wd + (x @ a) @ b * 0.25
+    y = lora_matmul(x, base, a, b, 0.25, arm="fused")
+    assert float(jnp.abs(y - refd(x, a, b)).max()) < 1e-4, f"{tag} fwd parity"
+    gf = jax.grad(lambda *o: jnp.sum(jnp.sin(lora_matmul(*o[:1], base, *o[1:], 0.25, arm="fused"))), argnums=(0, 1, 2))(x, a, b)
+    gr = jax.grad(lambda *o: jnp.sum(jnp.sin(refd(*o))), argnums=(0, 1, 2))(x, a, b)
+    for f_, r_ in zip(gf, gr):
+        assert float(jnp.abs(f_ - r_).max()) < 1e-4, f"{tag} grad parity"
+    print(f"fused kernel parity OK ({tag} base)")
+EOF
+
 python - "$WORK" <<'EOF'
 import sys, numpy as np
 from relora_tpu.data.memmap import MemmapTokenWriter, best_dtype

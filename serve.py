@@ -53,6 +53,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-dir", default=None, help="metrics.jsonl destination (request-loop mode)")
     p.add_argument("--no-scan", action="store_true", help="checkpoint was trained with scan_layers=false")
+    p.add_argument(
+        "--no-merge",
+        action="store_true",
+        help="serve LoRA factors unmerged (quantized bases / adapter hot-swap); "
+        "the decode forward routes the composite through ops/lora_dispatch",
+    )
     return p.parse_args(argv)
 
 
@@ -89,11 +95,25 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from relora_tpu.config.model import load_model_config
-    from relora_tpu.train.checkpoint import restore_serving_params
+    from relora_tpu.train.checkpoint import (
+        load_lora_spec,
+        restore_params_host,
+        restore_serving_params,
+    )
 
     model_cfg = load_model_config(args.model_config)
     logger.info(f"restoring {args.checkpoint}")
-    params = restore_serving_params(args.checkpoint)
+    lora_spec = None
+    if args.no_merge:
+        lora_spec = load_lora_spec(args.checkpoint)
+        if lora_spec is None:
+            raise SystemExit(
+                f"--no-merge: {args.checkpoint} has no relora_config.json sidecar "
+                "(full-rank checkpoint? drop the flag)"
+            )
+        params = restore_params_host(args.checkpoint)
+    else:
+        params = restore_serving_params(args.checkpoint)
 
     import jax
 
@@ -108,6 +128,7 @@ def main(argv=None) -> int:
         cache_size=cache_size,
         dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
         scan_layers=not args.no_scan,
+        lora=lora_spec,
     )
     key = jax.random.PRNGKey(args.seed)
 

@@ -216,6 +216,40 @@ def test_bucket_length():
         bucket_length(0)
 
 
+def test_engine_unmerged_lora_matches_merged():
+    """serve.py --no-merge path: an engine holding raw LoRA factors (decode
+    forward routed through the shape-aware dispatcher, weights_static) must
+    generate exactly the same tokens as the default merge-at-load engine."""
+    from relora_tpu.core.relora import LoraSpec, merged_params
+
+    spec = LoraSpec(r=4, alpha=8)
+    lora_model = build_decode_model(TINY_LLAMA, cache_size=32, lora=spec)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    raw = init_params(lora_model, jax.random.PRNGKey(0), ids)
+    # lora_b is zeros at init; perturb every lora_b so the branch contributes
+    raw = jax.tree_util.tree_map_with_path(
+        lambda path, t: (
+            jax.random.normal(
+                jax.random.PRNGKey(abs(hash(jax.tree_util.keystr(path))) % (2**31)),
+                t.shape,
+                t.dtype,
+            )
+            * 0.1
+            if any(getattr(k, "key", None) == "lora_b" for k in path)
+            else t
+        ),
+        raw,
+    )
+    unmerged = InferenceEngine(TINY_LLAMA, raw, cache_size=32, lora=spec)
+    merged = InferenceEngine(
+        TINY_LLAMA, merged_params(raw, spec), cache_size=32
+    )
+    prompts = [[1, 2, 3], [4, 5, 6, 7]]
+    out_unmerged = unmerged.generate(prompts, max_new_tokens=6)
+    out_merged = merged.generate(prompts, max_new_tokens=6)
+    assert out_unmerged == out_merged
+
+
 def test_engine_on_mesh():
     """Same engine code under an explicit device mesh: params shard per the
     logical rules, the cache batch axis shards over data, results match the
