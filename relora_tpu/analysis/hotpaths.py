@@ -49,8 +49,16 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
     ],
     "relora_tpu/serve/sampling.py": [""],  # jitted per decode step
     "relora_tpu/serve/scheduler.py": [
-        "ContinuousBatchingScheduler.run",  # the decode loop
+        "ContinuousBatchingScheduler.run",  # the drain loop
+        "ContinuousBatchingScheduler.step",  # one admit-plus-decode round
         "ContinuousBatchingScheduler._sample_rows",  # per decode step
+    ],
+    # the HTTP front-end's model thread calls scheduler.step() in a loop; a
+    # stray sync there stalls every in-flight stream.  The asyncio handlers
+    # and admission.py are host-side code that never touches device values —
+    # deliberately NOT hot, so RTL2xx stays scoped to the decode loop.
+    "relora_tpu/serve/server.py": [
+        "GenerateServer._model_loop",
     ],
 }
 

@@ -4,21 +4,32 @@ The serving counterpart of ``relora_tpu.train``: every ReLoRA checkpoint
 merges into a plain full-rank model (core/relora.merged_params), and this
 package runs it — ``engine.InferenceEngine`` for the jitted prefill/decode
 steps, ``sampling`` for jittable token selection, ``scheduler`` for the
-slot-based continuous-batching loop.  The ``serve.py`` CLI at the repo root
-ties them to checkpoint loading.
+slot-based continuous-batching core (incremental ``submit``/``step``/
+``cancel``), ``admission``/``server`` for the online HTTP front-end
+(bounded admission, SSE streaming, graceful drain).  The ``serve.py`` CLI
+at the repo root ties them to checkpoint loading.
 """
 
+from relora_tpu.serve.admission import AdmissionController, Draining, QueueFull, ServeMetrics, Ticket
 from relora_tpu.serve.engine import InferenceEngine, build_decode_model, bucket_length
 from relora_tpu.serve.sampling import SamplingParams, sample
 from relora_tpu.serve.scheduler import Completion, ContinuousBatchingScheduler, Request
+from relora_tpu.serve.server import GenerateServer, run_server
 
 __all__ = [
+    "AdmissionController",
     "Completion",
     "ContinuousBatchingScheduler",
+    "Draining",
+    "GenerateServer",
     "InferenceEngine",
+    "QueueFull",
     "Request",
     "SamplingParams",
+    "ServeMetrics",
+    "Ticket",
     "bucket_length",
     "build_decode_model",
+    "run_server",
     "sample",
 ]

@@ -1,0 +1,230 @@
+"""Bounded admission and serving metrics for the HTTP front-end.
+
+The scheduler (serve/scheduler.py) is single-threaded: one model thread owns
+``submit``/``step``/``cancel``.  This module is everything that crosses the
+thread boundary between the asyncio request handlers and that model thread:
+
+- ``AdmissionController`` — the *only* waiting room between the network and
+  the decode slots.  A ``queue.Queue(maxsize=max_queue)`` holds tickets the
+  model thread has not yet claimed; when it is full, ``try_admit`` raises
+  ``QueueFull`` and the server answers **429 + Retry-After** instead of
+  buffering without bound.  ``begin_drain()`` flips the controller into
+  drain mode (SIGTERM): new admissions raise ``Draining`` (**503**) while
+  already-accepted tickets keep flowing to the model thread — the same
+  request-a-stop-honor-it-at-the-boundary shape as
+  ``train/resilience.PreemptionGuard``, with the decode step as the
+  boundary.
+- ``Ticket`` — one accepted request plus its cross-thread plumbing: token /
+  finish callbacks (which hop onto the event loop via
+  ``loop.call_soon_threadsafe``) and a ``cancelled`` event the handler sets
+  on client disconnect so the model thread can free the slot.
+- ``ServeMetrics`` — thread-safe counters, gauges, and fixed-bucket
+  histograms behind the ``/metrics`` endpoint (Prometheus text exposition),
+  fed from both sides: handlers count requests and rejects, the model
+  thread observes TTFT / per-token latency and updates the queue/slot
+  gauges every step.
+
+Everything here is stdlib-only and jax-free, like relora_tpu/analysis — the
+front-end must import fast and run anywhere the linter runs.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from relora_tpu.serve.scheduler import Completion, Request
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity — shed load (HTTP 429)."""
+
+
+class Draining(Exception):
+    """Server is draining (SIGTERM) — reject new work (HTTP 503)."""
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One accepted request en route to the model thread."""
+
+    uid: int
+    request: Request
+    deadline: Optional[float]  # absolute time.monotonic(), None = no limit
+    on_token: Callable[[int, int, int], None]
+    on_finish: Callable[[Completion], None]
+    cancelled: threading.Event = dataclasses.field(default_factory=threading.Event)
+    t_enqueue: float = dataclasses.field(default_factory=time.monotonic)
+    t_last_token: Optional[float] = None  # model thread only; TPOT bookkeeping
+
+
+class AdmissionController:
+    """Bounded, drain-aware handoff from request handlers to the model thread.
+
+    ``try_admit`` (any thread) assigns the uid, enforces the bound, and
+    enqueues; ``pop`` (model thread) claims the next ticket.  The bound
+    covers only requests *waiting* for a slot — the model thread claims a
+    ticket when a decode slot is free, so total in-system work is
+    ``max_batch`` decoding + ``max_queue`` waiting, both fixed.
+    """
+
+    def __init__(self, max_queue: int, *, retry_after_s: float = 1.0):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self._q: "queue.Queue[Ticket]" = queue.Queue(maxsize=max_queue)
+        self._uids = itertools.count()
+        self._draining = threading.Event()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        self._draining.set()
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    def next_uid(self) -> int:
+        return next(self._uids)
+
+    def try_admit(self, ticket: Ticket) -> Ticket:
+        """Enqueue or reject — never block, never buffer beyond the bound."""
+        if self._draining.is_set():
+            raise Draining("server is draining; not accepting new requests")
+        try:
+            self._q.put_nowait(ticket)
+        except queue.Full:
+            raise QueueFull(
+                f"admission queue full ({self.max_queue} waiting); retry after "
+                f"{self.retry_after_s:.0f}s"
+            ) from None
+        return ticket
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Ticket]:
+        """Model thread: claim the next waiting ticket, or None on timeout
+        (``timeout=None`` polls without blocking)."""
+        try:
+            if timeout is None:
+                return self._q.get_nowait()
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+# -- metrics -----------------------------------------------------------------
+
+#: latency histogram buckets (seconds) — log-spaced over the TTFT/TPOT range
+#: a CPU dev box to a TPU pod actually spans
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics): counts per
+    upper bound, plus sum and count for rate/mean queries."""
+
+    def __init__(self, buckets: Tuple[float, ...] = LATENCY_BUCKETS):
+        self.bounds = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.total += value
+        self.count += 1
+
+
+class ServeMetrics:
+    """Thread-safe serving metrics with Prometheus text exposition.
+
+    Counters take an optional label pair (one level is all the cardinality
+    the front-end needs); gauges are set-to-latest; histograms observe
+    seconds.  ``render()`` produces the ``/metrics`` body; ``snapshot()``
+    returns a flat dict for JSONL / tests.
+    """
+
+    def __init__(self, namespace: str = "relora_serve"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Optional[Tuple[str, str]]], int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, label: Optional[Tuple[str, str]] = None, by: int = 1) -> None:
+        with self._lock:
+            key = (name, label)
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = Histogram()
+            hist.observe(value)
+
+    def counter_value(self, name: str, label: Optional[Tuple[str, str]] = None) -> int:
+        with self._lock:
+            return self._counters.get((name, label), 0)
+
+    def gauge_value(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict view: counters (labels joined with '.'), gauges, and
+        histogram count/sum — the shape MetricsLogger.log expects."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for (name, label), value in sorted(self._counters.items()):
+                key = name if label is None else f"{name}.{label[1]}"
+                out[key] = value
+            out.update(self._gauges)
+            for name, hist in self._hists.items():
+                out[f"{name}_count"] = hist.count
+                out[f"{name}_sum"] = round(hist.total, 6)
+            return out
+
+    def render(self) -> str:
+        """Prometheus text exposition (version 0.0.4)."""
+        with self._lock:
+            lines: List[str] = []
+            seen_types = set()
+            for (name, label), value in sorted(self._counters.items()):
+                full = f"{self.namespace}_{name}"
+                if full not in seen_types:
+                    lines.append(f"# TYPE {full} counter")
+                    seen_types.add(full)
+                if label is None:
+                    lines.append(f"{full} {value}")
+                else:
+                    lines.append(f'{full}{{{label[0]}="{label[1]}"}} {value}')
+            for name, value in sorted(self._gauges.items()):
+                full = f"{self.namespace}_{name}"
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {value:g}")
+            for name, hist in sorted(self._hists.items()):
+                full = f"{self.namespace}_{name}"
+                lines.append(f"# TYPE {full} histogram")
+                cumulative = 0
+                for bound, count in zip(hist.bounds, hist.counts):
+                    cumulative += count
+                    lines.append(f'{full}_bucket{{le="{bound:g}"}} {cumulative}')
+                cumulative += hist.counts[-1]
+                lines.append(f'{full}_bucket{{le="+Inf"}} {cumulative}')
+                lines.append(f"{full}_sum {hist.total:.6f}")
+                lines.append(f"{full}_count {hist.count}")
+            return "\n".join(lines) + "\n"

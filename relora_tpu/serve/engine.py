@@ -245,6 +245,23 @@ class InferenceEngine:
         ``dcache`` is donated; ``slot`` is traced (no retrace per slot)."""
         return self._insert(dcache, pcache, jnp.asarray(slot, jnp.int32))
 
+    def warmup(self, batch: int, *, prompt_buckets: Sequence[int] = (16,)) -> None:
+        """Compile the serving step functions before traffic arrives: one
+        prefill per prompt bucket, one insert, one decode at ``batch`` rows.
+        An online server calls this at startup so the first real request
+        pays queueing latency, not XLA compilation."""
+        pcache = None
+        for bucket in prompt_buckets:
+            T = min(bucket_length(bucket), self.cache_size)
+            _, pcache = self.prefill(jnp.zeros((1, T), jnp.int32))
+        cache = self.init_cache(batch)
+        if pcache is not None:
+            cache = self.insert(cache, pcache, 0)
+        logits, cache = self.decode(
+            cache, jnp.zeros((batch, 1), jnp.int32), jnp.zeros((batch, 1), jnp.int32)
+        )
+        jax.block_until_ready(logits)
+
     # -- convenience: one-shot batch generation ------------------------------
 
     def generate(

@@ -21,16 +21,35 @@ from the slot index or the global step — the batched greedy drain is
 token-identical to unbatched decode, and sampled requests reproduce across
 different interleavings.
 
+The scheduler is an *incremental* core so an online front-end
+(serve/server.py) can drive it one round at a time:
+
+- ``submit(req)`` queues a validated request (optionally with per-token /
+  completion callbacks and an absolute deadline);
+- ``step()`` performs one admit-plus-decode round and returns the requests
+  that finished during it;
+- ``cancel(uid)`` frees a request's slot mid-decode (client disconnects),
+  returning a partial completion;
+- ``run(requests)`` is a thin drain wrapper — submit everything, step until
+  idle — preserving the original batch CLI behavior exactly.
+
+The scheduler is single-threaded by design: all of ``submit``/``step``/
+``cancel`` must be called from one thread (the server's model thread);
+cross-thread admission is the AdmissionController's job (serve/admission.py).
+
 Per-request latency and throughput go to the existing metrics.jsonl sink
 (utils/logging.MetricsLogger): ``serve_request`` records with time-to-first-
-token, total latency, and decode tokens/sec.
+token, total latency, and decode tokens/sec, plus one ``serve/queue_depth``
+/ ``serve/active_slots`` gauge record per decode step so load tooling and
+the ``/metrics`` endpoint have a per-step signal.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, Iterable, List, Optional, Sequence
+from collections import deque
+from typing import Callable, Deque, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +60,11 @@ from relora_tpu.serve.sampling import SamplingParams
 from relora_tpu.utils.logging import MetricsLogger, get_logger
 
 logger = get_logger(__name__)
+
+#: uid, token id, token index within the generation (0 = first sampled token)
+TokenCallback = Callable[[int, int, int], None]
+#: called exactly once per request with its Completion
+FinishCallback = Callable[["Completion"], None]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,7 +83,7 @@ class Request:
 class Completion:
     uid: int
     tokens: List[int]
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "timeout" | "cancelled"
     prompt_tokens: int
     ttft_s: float
     latency_s: float
@@ -72,6 +96,7 @@ class _Slot:
     tokens: List[int]
     t_admit: float
     t_first: float
+    deadline: Optional[float] = None  # absolute time.monotonic(), None = no limit
 
 
 class ContinuousBatchingScheduler:
@@ -96,72 +121,152 @@ class ContinuousBatchingScheduler:
         self.metrics = metrics
         self.key = key if key is not None else jax.random.PRNGKey(0)
         self._step_count = 0
+        self._pending: Deque[Request] = deque()
+        self._slots: List[Optional[_Slot]] = [None] * max_batch
+        self._cache = None  # allocated on first admission, then persistent
+        self._tokens = np.zeros(max_batch, np.int32)
+        self._positions = np.zeros(max_batch, np.int32)
+        self._deadlines: Dict[int, float] = {}
+        self._on_token: Dict[int, TokenCallback] = {}
+        self._on_finish: Dict[int, FinishCallback] = {}
 
     def _request_key(self, req: Request, token_index: int) -> jax.Array:
         # keyed by (uid, token index): a request's sample stream does not
         # depend on which slot it landed in or what shares its batch
         return jax.random.fold_in(jax.random.fold_in(self.key, req.uid), token_index)
 
+    # -- incremental API ------------------------------------------------------
+
+    def validate_request(self, req: Request) -> None:
+        """Reject requests the decode loop could not serve: empty prompts and
+        prompts whose generation cannot fit the cache.  The server maps this
+        ``ValueError`` to HTTP 400; ``run()`` raises it from its preamble."""
+        need = len(req.prompt) + req.max_new_tokens
+        if len(req.prompt) < 1:
+            raise ValueError(f"request {req.uid}: empty prompt")
+        if need > self.engine.cache_size:
+            raise ValueError(
+                f"request {req.uid} needs {need} cache entries, "
+                f"capacity is {self.engine.cache_size}"
+            )
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.uid}: max_new_tokens must be >= 1, got {req.max_new_tokens}"
+            )
+
+    def submit(
+        self,
+        req: Request,
+        *,
+        on_token: Optional[TokenCallback] = None,
+        on_finish: Optional[FinishCallback] = None,
+        deadline: Optional[float] = None,
+    ) -> None:
+        """Queue a request for admission at the next ``step()``.
+
+        ``on_token(uid, token, index)`` fires for every token as it is
+        sampled (index 0 is the prefill's first token); ``on_finish`` fires
+        exactly once with the Completion.  ``deadline`` is an absolute
+        ``time.monotonic()`` bound — a request still decoding past it
+        finishes with its partial output and reason ``"timeout"``."""
+        self.validate_request(req)
+        if req.uid in self._deadlines or req.uid in self._on_finish or any(
+            r.uid == req.uid for r in self._pending
+        ) or any(s is not None and s.request.uid == req.uid for s in self._slots):
+            raise ValueError(f"request {req.uid}: uid already in flight")
+        if deadline is not None:
+            self._deadlines[req.uid] = deadline
+        if on_token is not None:
+            self._on_token[req.uid] = on_token
+        if on_finish is not None:
+            self._on_finish[req.uid] = on_finish
+        self._pending.append(req)
+
+    def cancel(self, uid: int, reason: str = "cancelled") -> Optional[Completion]:
+        """Free a request's slot (or drop it from the pending queue) and
+        report its partial output.  Returns the Completion, or None when the
+        uid is unknown (already finished — cancellation raced completion)."""
+        for req in list(self._pending):
+            if req.uid == uid:
+                self._pending.remove(req)
+                return self._finalize_unadmitted(req, reason)
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is not None and slot.request.uid == uid:
+                return self._retire(slot_idx, reason)
+        return None
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(s is not None for s in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    def step(self) -> List[Completion]:
+        """One admit-plus-decode round: expire deadlines, fill free slots
+        from the pending queue, then run one jitted decode over all slots.
+        Returns the requests that finished during the round (possibly at
+        admission, when the first token already satisfies the request)."""
+        finished: List[Completion] = []
+        self._expire_deadlines(finished)
+        while True:
+            self._admit_pass(finished)
+            if any(s is not None for s in self._slots) or not self._pending:
+                break
+            # everything admitted this round finished at once; keep admitting
+            # (mirrors the original drain loop's `continue` back to admission)
+        if not any(s is not None for s in self._slots):
+            return finished
+
+        # -- one decode step over all slots ----------------------------------
+        logits, self._cache = self.engine.decode(
+            self._cache,
+            jnp.asarray(self._tokens)[:, None],
+            jnp.asarray(self._positions)[:, None],
+        )
+        self._step_count += 1
+        # one bulk pull for the whole batch, then plain Python ints —
+        # per-slot int(next_tokens[i]) would be a device sync per row
+        next_tokens = self._sample_rows(logits, self._slots).tolist()
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tok = next_tokens[slot_idx]
+            slot.tokens.append(tok)
+            slot.pos += 1
+            self._tokens[slot_idx] = tok
+            self._positions[slot_idx] = slot.pos
+            self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
+            self._finish_if_done(slot_idx, finished)
+        if self.metrics is not None:
+            self.metrics.log(
+                {
+                    "serve/decode_step": self._step_count,
+                    "serve/queue_depth": len(self._pending),
+                    "serve/active_slots": self.active_slots,
+                }
+            )
+        return finished
+
     def run(self, requests: Iterable[Request]) -> Dict[int, Completion]:
         """Admit-and-decode until every request completes.  Returns
         completions keyed by ``Request.uid``."""
-        pending: List[Request] = list(requests)
-        for req in pending:
-            need = len(req.prompt) + req.max_new_tokens
-            if len(req.prompt) < 1:
-                raise ValueError(f"request {req.uid}: empty prompt")
-            if need > self.engine.cache_size:
-                raise ValueError(
-                    f"request {req.uid} needs {need} cache entries, "
-                    f"capacity is {self.engine.cache_size}"
-                )
-        slots: List[Optional[_Slot]] = [None] * self.max_batch
+        incoming = list(requests)
+        for req in incoming:
+            # validate everything before admitting anything, so a bad request
+            # raises without leaving earlier ones queued on the scheduler
+            self.validate_request(req)
+        for req in incoming:
+            self.submit(req)
         completions: Dict[int, Completion] = {}
-        cache = self.engine.init_cache(self.max_batch)
-        tokens = np.zeros(self.max_batch, np.int32)
-        positions = np.zeros(self.max_batch, np.int32)
         t_start = time.monotonic()
-
-        while pending or any(s is not None for s in slots):
-            # -- admit into free slots ---------------------------------------
-            for slot_idx in range(self.max_batch):
-                if slots[slot_idx] is not None or not pending:
-                    continue
-                req = pending.pop(0)
-                t_admit = time.monotonic()
-                cache, first = self._admit(req, slot_idx, cache)
-                slots[slot_idx] = _Slot(
-                    request=req,
-                    pos=len(req.prompt),
-                    tokens=[first],
-                    t_admit=t_admit,
-                    t_first=time.monotonic(),
-                )
-                tokens[slot_idx] = first
-                positions[slot_idx] = len(req.prompt)
-                self._finish_if_done(slots, slot_idx, completions)
-
-            if not any(s is not None for s in slots):
-                continue  # everything admitted this round finished at once
-
-            # -- one decode step over all slots ------------------------------
-            logits, cache = self.engine.decode(
-                cache, jnp.asarray(tokens)[:, None], jnp.asarray(positions)[:, None]
-            )
-            self._step_count += 1
-            # one bulk pull for the whole batch, then plain Python ints —
-            # per-slot int(next_tokens[i]) would be a device sync per row
-            next_tokens = self._sample_rows(logits, slots).tolist()
-            for slot_idx, slot in enumerate(slots):
-                if slot is None:
-                    continue
-                tok = next_tokens[slot_idx]
-                slot.tokens.append(tok)
-                slot.pos += 1
-                tokens[slot_idx] = tok
-                positions[slot_idx] = slot.pos
-                self._finish_if_done(slots, slot_idx, completions)
-
+        while self.has_work():
+            for completion in self.step():
+                completions[completion.uid] = completion
         logger.info(
             f"drained {len(completions)} requests in {time.monotonic() - t_start:.2f}s "
             f"({self._step_count} decode steps)"
@@ -169,6 +274,45 @@ class ContinuousBatchingScheduler:
         return completions
 
     # -- internals -----------------------------------------------------------
+
+    def _expire_deadlines(self, finished: List[Completion]) -> None:
+        if not self._deadlines:
+            return
+        now = time.monotonic()
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is not None and slot.deadline is not None and now >= slot.deadline:
+                finished.append(self._retire(slot_idx, "timeout"))
+
+    def _admit_pass(self, finished: List[Completion]) -> None:
+        for slot_idx in range(self.max_batch):
+            if self._slots[slot_idx] is not None or not self._pending:
+                continue
+            req = self._pending.popleft()
+            deadline = self._deadlines.get(req.uid)
+            if deadline is not None and time.monotonic() >= deadline:
+                # expired while queued: report the timeout without spending a
+                # prefill on it; the slot stays free for the next admission
+                finished.append(self._finalize_unadmitted(req, "timeout"))
+                continue
+            t_admit = time.monotonic()
+            self._cache, first = self._admit(req, slot_idx, self._ensure_cache())
+            self._slots[slot_idx] = _Slot(
+                request=req,
+                pos=len(req.prompt),
+                tokens=[first],
+                t_admit=t_admit,
+                t_first=time.monotonic(),
+                deadline=deadline,
+            )
+            self._tokens[slot_idx] = first
+            self._positions[slot_idx] = len(req.prompt)
+            self._emit_token(req.uid, first, 0)
+            self._finish_if_done(slot_idx, finished)
+
+    def _ensure_cache(self):
+        if self._cache is None:
+            self._cache = self.engine.init_cache(self.max_batch)
+        return self._cache
 
     def _admit(self, req: Request, slot_idx: int, cache):
         """Prefill one request (batch of 1, bucketed length) and copy its
@@ -208,8 +352,18 @@ class ContinuousBatchingScheduler:
         )
         return np.asarray(drawn)
 
-    def _finish_if_done(self, slots, slot_idx: int, completions) -> None:
-        slot = slots[slot_idx]
+    def _emit_token(self, uid: int, token: int, index: int) -> None:
+        callback = self._on_token.get(uid)
+        if callback is None:
+            return
+        try:
+            callback(uid, token, index)
+        except Exception as e:  # a dead stream must not kill the decode loop
+            logger.warning(f"request {uid}: token callback failed: {e!r}")
+            self._on_token.pop(uid, None)
+
+    def _finish_if_done(self, slot_idx: int, finished: List[Completion]) -> None:
+        slot = self._slots[slot_idx]
         req = slot.request
         last = slot.tokens[-1]
         reason = None
@@ -219,6 +373,13 @@ class ContinuousBatchingScheduler:
             reason = "length"
         if reason is None:
             return
+        finished.append(self._retire(slot_idx, reason))
+
+    def _retire(self, slot_idx: int, reason: str) -> Completion:
+        """Evict a slot (EOS / budget / timeout / cancel): build the
+        Completion, free the row — nothing recompiles — and notify."""
+        slot = self._slots[slot_idx]
+        req = slot.request
         now = time.monotonic()
         completion = Completion(
             uid=req.uid,
@@ -228,8 +389,7 @@ class ContinuousBatchingScheduler:
             ttft_s=slot.t_first - slot.t_admit,
             latency_s=now - slot.t_admit,
         )
-        completions[req.uid] = completion
-        slots[slot_idx] = None  # evict: slot is free, nothing recompiles
+        self._slots[slot_idx] = None  # evict: slot is free, nothing recompiles
         if self.metrics is not None:
             decode_s = max(now - slot.t_first, 1e-9)
             self.metrics.log(
@@ -244,4 +404,45 @@ class ContinuousBatchingScheduler:
                     if len(completion.tokens) > 1
                     else 0.0,
                 }
+            )
+        self._finalize(completion)
+        return completion
+
+    def _finalize_unadmitted(self, req: Request, reason: str) -> Completion:
+        """A request that never reached a slot (cancelled or expired while
+        queued): empty output, zero latency fields."""
+        completion = Completion(
+            uid=req.uid,
+            tokens=[],
+            finish_reason=reason,
+            prompt_tokens=len(req.prompt),
+            ttft_s=0.0,
+            latency_s=0.0,
+        )
+        if self.metrics is not None:
+            self.metrics.log(
+                {
+                    "serve_request": req.uid,
+                    "serve/prompt_tokens": completion.prompt_tokens,
+                    "serve/output_tokens": 0,
+                    "serve/finish_reason": reason,
+                    "serve/ttft_s": 0.0,
+                    "serve/latency_s": 0.0,
+                    "serve/decode_tokens_per_s": 0.0,
+                }
+            )
+        self._finalize(completion)
+        return completion
+
+    def _finalize(self, completion: Completion) -> None:
+        self._deadlines.pop(completion.uid, None)
+        self._on_token.pop(completion.uid, None)
+        callback = self._on_finish.pop(completion.uid, None)
+        if callback is None:
+            return
+        try:
+            callback(completion)
+        except Exception as e:
+            logger.warning(
+                f"request {completion.uid}: finish callback failed: {e!r}"
             )

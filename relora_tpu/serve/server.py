@@ -1,0 +1,626 @@
+"""Async HTTP/1.1 serving front-end: streaming generation over the scheduler.
+
+Stdlib-only (asyncio + sockets, like the analysis package keeps to ast): one
+listener accepts requests while a dedicated **model thread** drives the
+blocking jitted engine through the scheduler's incremental core — the decode
+loop never blocks the event loop, and the event loop never touches jax.
+
+Endpoints:
+
+- ``POST /v1/generate`` — body ``{"prompt": [ids...], "max_new_tokens": N,
+  "temperature": T, "top_p": P, "stream": true, "deadline_s": S}``.
+  Streaming responses are Server-Sent Events (``text/event-stream``): one
+  ``data: {"uid", "index", "token"}`` event per token as it is sampled, a
+  final ``data: {...finish record...}`` with the full token list and
+  latency fields, then ``data: [DONE]``.  ``"stream": false`` returns the
+  finish record as a single JSON body.
+- ``GET /healthz`` — readiness: 200 while accepting, 503 while draining
+  (load balancers stop routing before the listener goes away).
+- ``GET /metrics`` — Prometheus text exposition (serve/admission.ServeMetrics).
+
+Flow control, end to end:
+
+- **Backpressure**: the AdmissionController is the only waiting room; when
+  its bounded queue is full new requests get **429 + Retry-After** — memory
+  is fixed at ``max_batch`` decoding + ``max_queue`` waiting, no matter the
+  offered load, and in-flight streams are unaffected.
+- **Deadlines**: ``deadline_s`` bounds a request's wall time; the scheduler
+  expires it at the next step boundary and the stream finishes with its
+  partial output and ``finish_reason: "timeout"``.
+- **Disconnects**: a client that goes away mid-stream flips the ticket's
+  ``cancelled`` event; the model thread cancels the request at the next
+  step boundary, freeing the slot for the next admission.
+- **Graceful drain**: SIGTERM (or ``begin_drain()``) stops admissions (new
+  requests get **503**), finishes everything in flight *and* everything
+  already queued, then shuts the listener down — the update-boundary
+  pattern from train/resilience.PreemptionGuard, with the decode step as
+  the boundary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+from relora_tpu.serve.admission import (
+    AdmissionController,
+    Draining,
+    QueueFull,
+    ServeMetrics,
+    Ticket,
+)
+from relora_tpu.serve.scheduler import (
+    Completion,
+    ContinuousBatchingScheduler,
+    Request,
+)
+from relora_tpu.utils.logging import MetricsLogger, get_logger
+
+logger = get_logger(__name__)
+
+_MAX_BODY_BYTES = 16 << 20
+_REQUEST_TIMEOUT_S = 30.0
+_IDLE_POP_S = 0.02
+
+
+def _completion_record(completion: Completion) -> Dict[str, Any]:
+    return {
+        "uid": completion.uid,
+        "finish_reason": completion.finish_reason,
+        "tokens": completion.tokens,
+        "prompt_tokens": completion.prompt_tokens,
+        "output_tokens": len(completion.tokens),
+        "ttft_s": round(completion.ttft_s, 6),
+        "latency_s": round(completion.latency_s, 6),
+    }
+
+
+class BadRequest(Exception):
+    """Malformed request body — HTTP 400."""
+
+
+def parse_generate_body(
+    body: bytes,
+    *,
+    default_max_new_tokens: int,
+    default_temperature: float,
+    default_top_p: float,
+) -> Dict[str, Any]:
+    """Validate the /v1/generate JSON body into plain fields (no uid yet).
+    Raises BadRequest with a reader-facing message on any violation."""
+    try:
+        payload = json.loads(body.decode("utf-8") or "{}")
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise BadRequest(f"body is not valid JSON: {e}") from None
+    if not isinstance(payload, dict):
+        raise BadRequest("body must be a JSON object")
+    prompt = payload.get("prompt")
+    if not isinstance(prompt, list) or not all(
+        isinstance(t, int) and not isinstance(t, bool) for t in prompt
+    ):
+        raise BadRequest('"prompt" must be a list of token ids (ints)')
+    max_new = payload.get("max_new_tokens", default_max_new_tokens)
+    if not isinstance(max_new, int) or isinstance(max_new, bool) or max_new < 1:
+        raise BadRequest('"max_new_tokens" must be an int >= 1')
+    temperature = payload.get("temperature", default_temperature)
+    top_p = payload.get("top_p", default_top_p)
+    if not isinstance(temperature, (int, float)) or temperature < 0:
+        raise BadRequest('"temperature" must be a number >= 0')
+    if not isinstance(top_p, (int, float)) or not 0.0 < top_p <= 1.0:
+        raise BadRequest('"top_p" must be in (0, 1]')
+    stream = payload.get("stream", True)
+    if not isinstance(stream, bool):
+        raise BadRequest('"stream" must be a boolean')
+    deadline_s = payload.get("deadline_s")
+    if deadline_s is not None and (
+        not isinstance(deadline_s, (int, float)) or deadline_s <= 0
+    ):
+        raise BadRequest('"deadline_s" must be a number > 0')
+    return {
+        "prompt": prompt,
+        "max_new_tokens": max_new,
+        "temperature": float(temperature),
+        "top_p": float(top_p),
+        "stream": stream,
+        "deadline_s": deadline_s,
+    }
+
+
+class GenerateServer:
+    """Asyncio front-end over a ContinuousBatchingScheduler.
+
+    The constructor takes an *idle* scheduler (the server's model thread
+    becomes its single driving thread).  ``serve_forever()`` binds, starts
+    the model thread, and runs until a drain completes; ``begin_drain()``
+    (thread-safe, also wired to SIGTERM) initiates shutdown.
+    """
+
+    def __init__(
+        self,
+        scheduler: ContinuousBatchingScheduler,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        max_queue: int = 64,
+        default_max_new_tokens: int = 64,
+        default_temperature: float = 0.0,
+        default_top_p: float = 1.0,
+        retry_after_s: float = 1.0,
+        metrics: Optional[MetricsLogger] = None,
+    ):
+        self.scheduler = scheduler
+        self.host = host
+        self.port = port  # rebound to the real port after bind (port=0 = ephemeral)
+        self.admission = AdmissionController(max_queue, retry_after_s=retry_after_s)
+        self.stats = ServeMetrics()
+        self.metrics = metrics
+        self.default_max_new_tokens = default_max_new_tokens
+        self.default_temperature = default_temperature
+        self.default_top_p = default_top_p
+        self.started = threading.Event()  # set once the listener is bound
+        self.drained = threading.Event()  # set once the model thread exits
+        self._t_start = time.monotonic()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._shutdown: Optional[asyncio.Event] = None
+        self._handler_tasks: Set[asyncio.Task] = set()
+        self._active: Dict[int, Ticket] = {}  # model thread only
+        self._worker = threading.Thread(
+            target=self._model_loop, name="serve-model", daemon=True
+        )
+        self._worker_error: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admitting (new requests get 503), finish in-flight and queued
+        work, then shut down.  Thread-safe and idempotent."""
+        if self.admission.draining:
+            return
+        logger.info("drain requested: rejecting new requests, finishing in-flight")
+        self.admission.begin_drain()
+        self.stats.set_gauge("draining", 1)
+        if self.metrics is not None:
+            self.metrics.event(
+                "serve_drain_begin",
+                queue_depth=self.admission.depth(),
+                active_slots=self.scheduler.active_slots,
+            )
+
+    async def serve_forever(self, *, install_signal_handlers: bool = True) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._shutdown = asyncio.Event()
+        server = await asyncio.start_server(self._client_connected, self.host, self.port)
+        self.port = server.sockets[0].getsockname()[1]
+        if install_signal_handlers:
+            try:
+                self._loop.add_signal_handler(signal.SIGTERM, self.begin_drain)
+            except (NotImplementedError, RuntimeError):
+                # non-main thread or non-Unix loop: callers drain explicitly
+                logger.warning("SIGTERM handler unavailable; use begin_drain()")
+        self.stats.set_gauge("draining", 0)
+        self._worker.start()
+        self.started.set()
+        logger.info(f"serving on http://{self.host}:{self.port}")
+        async with server:
+            await self._shutdown.wait()
+            server.close()
+            await server.wait_closed()
+        if self._handler_tasks:
+            # finish events are already queued on the loop; give handlers a
+            # bounded grace to flush their final bytes
+            await asyncio.wait(set(self._handler_tasks), timeout=10.0)
+        if self.metrics is not None:
+            self.metrics.event("serve_drain_complete", **self.stats.snapshot())
+        logger.info("drain complete; server stopped")
+        if self._worker_error is not None:
+            raise RuntimeError("model thread died") from self._worker_error
+
+    def _signal_shutdown(self) -> None:
+        loop, shutdown = self._loop, self._shutdown
+        if loop is None or shutdown is None:
+            return
+        try:
+            loop.call_soon_threadsafe(shutdown.set)
+        except RuntimeError:
+            pass  # loop already closed
+
+    # -- model thread --------------------------------------------------------
+
+    def _model_loop(self) -> None:
+        """The scheduler's single driving thread: claim tickets while slots
+        are free, apply cancellations, run one decode round, repeat.  Exits
+        when draining and nothing is left anywhere."""
+        sched = self.scheduler
+        try:
+            while True:
+                while sched.active_slots + sched.queue_depth < sched.max_batch:
+                    ticket = self.admission.pop(timeout=None)
+                    if ticket is None:
+                        break
+                    self._claim(ticket)
+                for uid, ticket in list(self._active.items()):
+                    if ticket.cancelled.is_set():
+                        sched.cancel(uid)  # fires on_finish -> _active cleanup
+                self.stats.set_gauge(
+                    "queue_depth", self.admission.depth() + sched.queue_depth
+                )
+                self.stats.set_gauge("active_slots", sched.active_slots)
+                if sched.has_work():
+                    sched.step()
+                    continue
+                if self.admission.draining and self.admission.depth() == 0:
+                    break
+                ticket = self.admission.pop(timeout=_IDLE_POP_S)
+                if ticket is not None:
+                    self._claim(ticket)
+        except BaseException as e:
+            self._worker_error = e
+            logger.error(f"model thread died: {e!r}")
+        finally:
+            self.drained.set()
+            self._signal_shutdown()
+
+    def _claim(self, ticket: Ticket) -> None:
+        """Hand one admitted ticket to the scheduler (model thread only)."""
+        if ticket.cancelled.is_set():
+            # client left while the request was still queued: never admit it
+            self.stats.inc("requests_finished_total", ("reason", "cancelled"))
+            ticket.on_finish(
+                Completion(
+                    uid=ticket.uid,
+                    tokens=[],
+                    finish_reason="cancelled",
+                    prompt_tokens=len(ticket.request.prompt),
+                    ttft_s=0.0,
+                    latency_s=0.0,
+                )
+            )
+            return
+        self._active[ticket.uid] = ticket
+
+        def on_token(uid: int, token: int, index: int, _t: Ticket = ticket) -> None:
+            now = time.monotonic()
+            if index == 0:
+                self.stats.observe("ttft_seconds", now - _t.t_enqueue)
+            elif _t.t_last_token is not None:
+                self.stats.observe("tpot_seconds", now - _t.t_last_token)
+            _t.t_last_token = now
+            self.stats.inc("tokens_generated_total")
+            _t.on_token(uid, token, index)
+
+        def on_finish(completion: Completion, _t: Ticket = ticket) -> None:
+            self._active.pop(completion.uid, None)
+            self.stats.inc("requests_finished_total", ("reason", completion.finish_reason))
+            self.stats.observe(
+                "e2e_latency_seconds", time.monotonic() - _t.t_enqueue
+            )
+            _t.on_finish(completion)
+
+        self.scheduler.submit(
+            ticket.request,
+            on_token=on_token,
+            on_finish=on_finish,
+            deadline=ticket.deadline,
+        )
+
+    # -- asyncio handlers ----------------------------------------------------
+
+    async def _client_connected(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._handler_tasks.add(task)
+            task.add_done_callback(self._handler_tasks.discard)
+        try:
+            await self._handle(reader, writer)
+        except (ConnectionError, asyncio.IncompleteReadError, TimeoutError):
+            pass  # client went away; per-request cleanup already ran
+        except Exception as e:
+            logger.warning(f"handler error: {e!r}")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            parsed = await asyncio.wait_for(_read_http_request(reader), _REQUEST_TIMEOUT_S)
+        except ValueError as e:
+            await _respond_json(writer, 400, {"error": str(e)})
+            return
+        if parsed is None:
+            return
+        method, path, _headers, body = parsed
+        route = path.split("?", 1)[0]
+        if route == "/healthz" and method == "GET":
+            self.stats.inc("http_requests_total", ("route", "healthz"))
+            await self._handle_healthz(writer)
+        elif route == "/metrics" and method == "GET":
+            self.stats.inc("http_requests_total", ("route", "metrics"))
+            await _respond(writer, 200, self.stats.render(), content_type="text/plain; version=0.0.4")
+        elif route == "/v1/generate":
+            self.stats.inc("http_requests_total", ("route", "generate"))
+            if method != "POST":
+                await _respond_json(writer, 405, {"error": "use POST"})
+                return
+            await self._handle_generate(reader, writer, body)
+        else:
+            self.stats.inc("http_requests_total", ("route", "other"))
+            await _respond_json(writer, 404, {"error": f"no route {route}"})
+
+    async def _handle_healthz(self, writer: asyncio.StreamWriter) -> None:
+        draining = self.admission.draining
+        status = 503 if draining else 200
+        await _respond_json(
+            writer,
+            status,
+            {
+                "status": "draining" if draining else "ok",
+                "active_slots": self.scheduler.active_slots,
+                "queue_depth": self.admission.depth() + self.scheduler.queue_depth,
+                "max_batch": self.scheduler.max_batch,
+                "max_queue": self.admission.max_queue,
+                "uptime_s": round(time.monotonic() - self._t_start, 3),
+            },
+        )
+
+    async def _handle_generate(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        body: bytes,
+    ) -> None:
+        try:
+            fields = parse_generate_body(
+                body,
+                default_max_new_tokens=self.default_max_new_tokens,
+                default_temperature=self.default_temperature,
+                default_top_p=self.default_top_p,
+            )
+            req = Request(
+                uid=self.admission.next_uid(),
+                prompt=fields["prompt"],
+                max_new_tokens=fields["max_new_tokens"],
+                temperature=fields["temperature"],
+                top_p=fields["top_p"],
+            )
+            # capacity/validity errors surface as 400 here, before admission,
+            # instead of crashing the decode loop later
+            self.scheduler.validate_request(req)
+        except (BadRequest, ValueError) as e:
+            self.stats.inc("rejected_total", ("reason", "bad_request"))
+            await _respond_json(writer, 400, {"error": str(e)})
+            return
+
+        loop = asyncio.get_running_loop()
+        events: "asyncio.Queue[Tuple[str, Any, Any]]" = asyncio.Queue()
+
+        def post(kind: str, a: Any = None, b: Any = None) -> None:
+            try:
+                loop.call_soon_threadsafe(events.put_nowait, (kind, a, b))
+            except RuntimeError:
+                pass  # loop closed mid-drain; the record still lands in metrics
+
+        deadline = (
+            time.monotonic() + fields["deadline_s"]
+            if fields["deadline_s"] is not None
+            else None
+        )
+        ticket = Ticket(
+            uid=req.uid,
+            request=req,
+            deadline=deadline,
+            on_token=lambda uid, tok, idx: post("token", tok, idx),
+            on_finish=lambda completion: post("finish", completion),
+        )
+        try:
+            self.admission.try_admit(ticket)
+        except QueueFull as e:
+            self.stats.inc("rejected_total", ("reason", "queue_full"))
+            await _respond_json(
+                writer,
+                429,
+                {"error": str(e)},
+                extra_headers={"Retry-After": f"{self.admission.retry_after_s:.0f}"},
+            )
+            return
+        except Draining as e:
+            self.stats.inc("rejected_total", ("reason", "draining"))
+            await _respond_json(
+                writer,
+                503,
+                {"error": str(e)},
+                extra_headers={"Retry-After": f"{self.admission.retry_after_s:.0f}"},
+            )
+            return
+
+        if fields["stream"]:
+            await self._stream_response(reader, writer, ticket, events)
+        else:
+            await self._unary_response(reader, writer, ticket, events)
+
+    async def _stream_response(self, reader, writer, ticket, events) -> None:
+        writer.write(
+            _head(200, "OK", "text/event-stream", {"Cache-Control": "no-cache"})
+        )
+        await writer.drain()
+        eof_watch = asyncio.ensure_future(reader.read(1))
+        try:
+            while True:
+                getter = asyncio.ensure_future(events.get())
+                done, _ = await asyncio.wait(
+                    {getter, eof_watch}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if eof_watch in done and getter not in done:
+                    getter.cancel()
+                    self._client_gone(ticket)
+                    return
+                kind, a, b = getter.result()
+                if kind == "token":
+                    event = {"uid": ticket.uid, "index": b, "token": a}
+                    writer.write(_sse(event))
+                    try:
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        self._client_gone(ticket)
+                        return
+                else:  # finish
+                    writer.write(_sse(_completion_record(a)))
+                    writer.write(b"data: [DONE]\n\n")
+                    await writer.drain()
+                    return
+        finally:
+            if not eof_watch.done():
+                eof_watch.cancel()
+
+    async def _unary_response(self, reader, writer, ticket, events) -> None:
+        eof_watch = asyncio.ensure_future(reader.read(1))
+        try:
+            while True:
+                getter = asyncio.ensure_future(events.get())
+                done, _ = await asyncio.wait(
+                    {getter, eof_watch}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if eof_watch in done and getter not in done:
+                    getter.cancel()
+                    self._client_gone(ticket)
+                    return
+                kind, a, _b = getter.result()
+                if kind == "finish":
+                    await _respond_json(writer, 200, _completion_record(a))
+                    return
+        finally:
+            if not eof_watch.done():
+                eof_watch.cancel()
+
+    def _client_gone(self, ticket: Ticket) -> None:
+        """The client disconnected mid-request: flag the ticket so the model
+        thread frees its slot at the next step boundary."""
+        ticket.cancelled.set()
+        self.stats.inc("disconnects_total")
+
+
+# -- wire helpers ------------------------------------------------------------
+
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    413: "Payload Too Large", 429: "Too Many Requests", 500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+def _head(
+    status: int,
+    reason: str,
+    content_type: str,
+    extra: Optional[Dict[str, str]] = None,
+    content_length: Optional[int] = None,
+) -> bytes:
+    lines = [
+        f"HTTP/1.1 {status} {reason}",
+        f"Content-Type: {content_type}",
+        "Connection: close",
+    ]
+    if content_length is not None:
+        lines.append(f"Content-Length: {content_length}")
+    for k, v in (extra or {}).items():
+        lines.append(f"{k}: {v}")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode()
+
+
+def _sse(obj: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+
+async def _respond(
+    writer: asyncio.StreamWriter,
+    status: int,
+    body: str,
+    *,
+    content_type: str = "text/plain",
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> None:
+    payload = body.encode()
+    writer.write(
+        _head(status, _REASONS.get(status, "?"), content_type, extra_headers, len(payload))
+    )
+    writer.write(payload)
+    await writer.drain()
+
+
+async def _respond_json(
+    writer: asyncio.StreamWriter,
+    status: int,
+    obj: Dict[str, Any],
+    *,
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> None:
+    await _respond(
+        writer,
+        status,
+        json.dumps(obj),
+        content_type="application/json",
+        extra_headers=extra_headers,
+    )
+
+
+async def _read_http_request(
+    reader: asyncio.StreamReader,
+) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+    """Minimal HTTP/1.1 request parser: request line, headers, Content-Length
+    body.  Returns None on an empty connection (health-checker port probes)."""
+    line = await reader.readline()
+    if not line.strip():
+        return None
+    parts = line.decode("latin-1").split()
+    if len(parts) < 3:
+        raise ValueError(f"malformed request line: {line!r}")
+    method, target = parts[0].upper(), parts[1]
+    headers: Dict[str, str] = {}
+    while True:
+        raw = await reader.readline()
+        if raw in (b"\r\n", b"\n", b""):
+            break
+        key, _, value = raw.decode("latin-1").partition(":")
+        headers[key.strip().lower()] = value.strip()
+    length = int(headers.get("content-length", "0") or "0")
+    if length > _MAX_BODY_BYTES:
+        raise ValueError(f"body too large: {length} bytes")
+    body = await reader.readexactly(length) if length else b""
+    return method, target, headers, body
+
+
+def run_server(
+    scheduler: ContinuousBatchingScheduler,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    ready_cb: Optional[Callable[["GenerateServer"], None]] = None,
+    **kwargs: Any,
+) -> int:
+    """Blocking entry point for the CLI: build a GenerateServer, run it until
+    a SIGTERM drain completes.  ``ready_cb(server)`` fires once the listener
+    is bound (the CLI writes the chosen port for --port 0)."""
+    server = GenerateServer(scheduler, host=host, port=port, **kwargs)
+
+    async def _main() -> None:
+        serve = asyncio.ensure_future(server.serve_forever())
+        while not server.started.is_set():
+            await asyncio.sleep(0.01)
+            if serve.done():
+                break
+        if ready_cb is not None and not serve.done():
+            ready_cb(server)
+        await serve
+
+    asyncio.run(_main())
+    return 0

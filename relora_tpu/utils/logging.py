@@ -15,6 +15,7 @@ import json
 import logging
 import os
 import sys
+import threading
 import time
 import warnings
 from typing import Any, Mapping, Optional
@@ -90,6 +91,10 @@ class MetricsLogger:
         self.run_id = resume_id
         self._fh = None
         self._wandb = None
+        # JSONL writes are line-atomic under this lock: the serving front-end
+        # logs from its model thread while the event-loop thread logs
+        # lifecycle events, and interleaved half-lines would corrupt the file
+        self._lock = threading.Lock()
         if not self.enabled:
             return
         if run_dir is not None:
@@ -128,9 +133,10 @@ class MetricsLogger:
         if step is not None:
             record["_step"] = step
         record["_time"] = time.time()
-        if self._fh is not None:
-            self._fh.write(json.dumps(record) + "\n")
-            self._fh.flush()
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
         if self._wandb is not None:
             self._wandb.log(dict(metrics), step=step)
 
@@ -153,9 +159,10 @@ class MetricsLogger:
         if step is not None:
             record["_step"] = step
         record["_time"] = time.time()
-        if self._fh is not None:
-            self._fh.write(json.dumps(record) + "\n")
-            self._fh.flush()
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
         if self._wandb is not None:
             self._wandb.log(
                 {
@@ -179,9 +186,10 @@ class MetricsLogger:
         if step is not None:
             record["_step"] = step
         record["_time"] = time.time()
-        if self._fh is not None:
-            self._fh.write(json.dumps(record) + "\n")
-            self._fh.flush()
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
 
     def alert(self, title: str, text: str) -> None:
         """Parity: wandb.alert on bad post-reset LR (training_utils.py:397-404)."""
@@ -193,9 +201,10 @@ class MetricsLogger:
                 pass
 
     def finish(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
         if self._wandb is not None:
             self._wandb.finish()
 

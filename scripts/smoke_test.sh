@@ -123,4 +123,33 @@ python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
     --max-batch 2 --eos-id -1 --run-dir "$WORK/serve_run"
 grep -q serve_request "$WORK/serve_run/metrics.jsonl"
 
+echo "=== 9. HTTP serving front-end (boot, healthz, stream, SIGTERM drain) ==="
+rm -f "$WORK/serve_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/serve_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 6 --eos-id -1 &
+SERVER_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/serve_port" ] && break; sleep 0.2; done
+[ -s "$WORK/serve_port" ] || { echo "server never wrote its port"; kill "$SERVER_PID"; exit 1; }
+python - "$(cat "$WORK/serve_port")" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+assert health["status"] == "ok", health
+req = urllib.request.Request(
+    f"http://127.0.0.1:{port}/v1/generate",
+    data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 6}).encode(),
+)
+with urllib.request.urlopen(req, timeout=120) as resp:
+    events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+assert events[-1].strip() == b"[DONE]", events
+final = json.loads(events[-2])
+assert final["finish_reason"] == "length" and len(final["tokens"]) == 6, final
+metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+assert "relora_serve_tokens_generated_total 6" in metrics, metrics
+print("HTTP stream OK:", final["tokens"])
+EOF
+kill -TERM "$SERVER_PID"
+wait "$SERVER_PID"   # exit 0 = SIGTERM drain completed cleanly
+
 echo "SMOKE OK"

@@ -7,7 +7,11 @@ KV-cache engine (relora_tpu/serve).  Two modes:
 - one-shot: ``--prompt`` (repeatable) generates for the given prompts and
   prints one result per line;
 - request loop: ``--input-file FILE`` (or ``-`` for stdin) reads one request
-  per line and drains them through the continuous-batching scheduler.
+  per line and drains them through the continuous-batching scheduler;
+- online server: ``--port`` launches the async HTTP front-end
+  (relora_tpu/serve/server.py) — ``POST /v1/generate`` with SSE token
+  streaming, ``/healthz``, ``/metrics``, bounded admission (429 on
+  overload), and SIGTERM graceful drain.  See docs/serving.md.
 
 Prompts are token ids (comma- or space-separated ints) by default, so the CLI
 has no tokenizer dependency; ``--tokenizer NAME`` opts into HF tokenization
@@ -23,6 +27,10 @@ Examples::
     python serve.py --checkpoint ckpts/relora/model_20000 \
         --model_config llama_250m --input-file prompts.txt \
         --temperature 0.8 --top-p 0.9 --max-batch 8 --run-dir runs/serve
+
+    # online HTTP server, 8 decode slots, 64 waiting requests max
+    python serve.py --checkpoint ckpts/relora/model_20000 \
+        --model_config llama_250m --port 8000 --max-batch 8 --max-queue 64
 """
 
 from __future__ import annotations
@@ -51,7 +59,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-batch", type=int, default=4, help="decode slots (request-loop mode)")
     p.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--run-dir", default=None, help="metrics.jsonl destination (request-loop mode)")
+    p.add_argument("--run-dir", default=None, help="metrics.jsonl destination (request-loop/server mode)")
+    p.add_argument("--port", type=int, default=None, help="launch the HTTP server on this port (0 = ephemeral)")
+    p.add_argument("--host", default="127.0.0.1", help="server bind address")
+    p.add_argument("--max-queue", type=int, default=64, help="server: max waiting requests before 429")
+    p.add_argument("--port-file", default=None, help="server: write the bound port here once listening")
+    p.add_argument("--no-warmup", action="store_true", help="server: skip compile warmup at startup")
     p.add_argument("--no-scan", action="store_true", help="checkpoint was trained with scan_layers=false")
     p.add_argument(
         "--no-merge",
@@ -85,6 +98,14 @@ def main(argv=None) -> int:
     honor_platform_request()
     args = parse_args(argv)
     logger = get_logger("relora_tpu.serve")
+
+    if args.prompt and args.input_file:
+        raise SystemExit(
+            "--prompt and --input-file are mutually exclusive: one-shot mode "
+            "would silently ignore the file; pass one or the other"
+        )
+    if args.port is not None and (args.prompt or args.input_file):
+        raise SystemExit("--port runs the HTTP server; drop --prompt/--input-file")
 
     tokenizer = None
     if args.tokenizer:
@@ -131,6 +152,44 @@ def main(argv=None) -> int:
         lora=lora_spec,
     )
     key = jax.random.PRNGKey(args.seed)
+
+    if args.port is not None:
+        from relora_tpu.serve.scheduler import ContinuousBatchingScheduler
+        from relora_tpu.serve.server import run_server
+        from relora_tpu.utils.logging import MetricsLogger
+
+        if not args.no_warmup:
+            logger.info("warming serving compiles (disable with --no-warmup)")
+            engine.warmup(args.max_batch)
+        metrics = MetricsLogger(run_dir=args.run_dir) if args.run_dir else None
+        scheduler = ContinuousBatchingScheduler(
+            engine,
+            max_batch=args.max_batch,
+            eos_id=eos_id,
+            top_k=args.top_k,
+            metrics=metrics,
+            key=key,
+        )
+
+        def ready(server):
+            if args.port_file:
+                with open(args.port_file, "w") as f:
+                    f.write(str(server.port))
+
+        rc = run_server(
+            scheduler,
+            host=args.host,
+            port=args.port,
+            max_queue=args.max_queue,
+            default_max_new_tokens=args.max_new_tokens,
+            default_temperature=args.temperature,
+            default_top_p=args.top_p,
+            metrics=metrics,
+            ready_cb=ready,
+        )
+        if metrics is not None:
+            metrics.finish()
+        return rc
 
     if args.prompt:
         prompts = [_encode(t, tokenizer) for t in args.prompt]

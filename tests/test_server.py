@@ -1,0 +1,437 @@
+"""HTTP front-end tests: the asyncio server over the incremental scheduler.
+
+Covers the serving acceptance criteria on CPU with a tiny model:
+
+- streamed SSE output is token-identical to ``scheduler.run()`` for the same
+  (uid, key) — HTTP adds transport, not nondeterminism;
+- a full admission queue answers 429 + Retry-After while in-flight streams
+  keep going (bounded memory under overload);
+- ``deadline_s`` expiry mid-decode returns the partial output with
+  ``finish_reason: "timeout"``;
+- a client disconnect frees the decode slot for the next request;
+- drain (the SIGTERM handler's body; the real signal is exercised by
+  scripts/smoke_test.sh) finishes in-flight work, 503s new work, and stops
+  the server.
+
+The server runs in a background thread (signal handlers off — they need the
+main thread's loop); clients are raw close-delimited HTTP/1.1 sockets, so
+these tests pin the exact wire format the stdlib front-end speaks.
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.models.params_util import init_params
+from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+from relora_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+from relora_tpu.serve.server import BadRequest, GenerateServer, parse_generate_body
+
+pytestmark = pytest.mark.serve
+
+TINY = ModelConfig(
+    family="llama",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=512,
+)
+CACHE = 512
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model = build_decode_model(TINY, cache_size=CACHE)
+    base = type(model)(TINY, lora=None, dtype=jnp.float32, scan_layers=True)
+    params = init_params(base, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return InferenceEngine(TINY, params, cache_size=CACHE)
+
+
+class _Server:
+    """Run a GenerateServer in a background thread for the duration of a test.
+
+    Exit drains (idempotent if the test already drained) and asserts the
+    model thread did not die — a worker exception fails the test instead of
+    hanging it."""
+
+    def __init__(self, engine, *, max_batch=1, max_queue=4, key_seed=0, **kwargs):
+        self.scheduler = ContinuousBatchingScheduler(
+            engine, max_batch=max_batch, key=jax.random.PRNGKey(key_seed)
+        )
+        self.server = GenerateServer(
+            self.scheduler, port=0, max_queue=max_queue, **kwargs
+        )
+        self.thread = threading.Thread(
+            target=lambda: asyncio.run(
+                self.server.serve_forever(install_signal_handlers=False)
+            ),
+            daemon=True,
+        )
+
+    def __enter__(self) -> GenerateServer:
+        self.thread.start()
+        assert self.server.started.wait(60), "server failed to start"
+        return self.server
+
+    def __exit__(self, *exc):
+        self.server.begin_drain()
+        self.thread.join(60)
+        assert not self.thread.is_alive(), "server did not drain within 60s"
+        assert self.server._worker_error is None, repr(self.server._worker_error)
+
+
+# -- raw HTTP/1.1 clients (close-delimited, like the server speaks) -----------
+
+
+def _request_bytes(method: str, path: str, body: bytes) -> bytes:
+    head = (
+        f"{method} {path} HTTP/1.1\r\nHost: test\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    )
+    return head.encode() + body
+
+
+def _parse_response(data: bytes):
+    head, _, rest = data.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split(b" ", 2)[1])
+    headers = {}
+    for line in lines[1:]:
+        key, _, value = line.decode("latin-1").partition(":")
+        headers[key.strip().lower()] = value.strip()
+    return status, headers, rest
+
+
+def _http(port: int, method: str, path: str, body=None, timeout=60.0):
+    """One request, read to EOF (the server closes every connection)."""
+    payload = b"" if body is None else (
+        body if isinstance(body, bytes) else json.dumps(body).encode()
+    )
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as sock:
+        sock.sendall(_request_bytes(method, path, payload))
+        data = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    return _parse_response(data)
+
+
+def _sse_events(body: bytes):
+    events = []
+    for block in body.decode().split("\n\n"):
+        block = block.strip()
+        if not block.startswith("data: "):
+            continue
+        payload = block[len("data: "):]
+        events.append("[DONE]" if payload == "[DONE]" else json.loads(payload))
+    return events
+
+
+def _generate(port: int, payload: dict):
+    """POST /v1/generate and split the SSE stream into (tokens, final record)."""
+    status, headers, body = _http(port, "POST", "/v1/generate", payload)
+    assert status == 200, body
+    events = _sse_events(body)
+    assert events[-1] == "[DONE]"
+    final = events[-2]
+    token_events = events[:-2]
+    assert [e["index"] for e in token_events] == list(range(len(token_events)))
+    return [e["token"] for e in token_events], final
+
+
+class _Stream:
+    """An open streaming request: read SSE events one at a time, or hang up
+    mid-stream (the disconnect / overload tests)."""
+
+    def __init__(self, port: int, payload: dict, timeout=60.0):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+        self.sock.sendall(
+            _request_bytes("POST", "/v1/generate", json.dumps(payload).encode())
+        )
+        self.buf = b""
+        head = self._read_until(b"\r\n\r\n")
+        assert head is not None, "no response head"
+        self.status = int(head.split(b" ", 2)[1])
+
+    def _read_until(self, marker: bytes):
+        while marker not in self.buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                return None
+            self.buf += chunk
+        idx = self.buf.index(marker) + len(marker)
+        out, self.buf = self.buf[:idx], self.buf[idx:]
+        return out
+
+    def next_event(self):
+        block = self._read_until(b"\n\n")
+        if block is None:
+            return None
+        text = block.decode().strip()
+        assert text.startswith("data: "), text
+        payload = text[len("data: "):]
+        return "[DONE]" if payload == "[DONE]" else json.loads(payload)
+
+    def read_to_done(self):
+        events = []
+        while True:
+            event = self.next_event()
+            assert event is not None, "stream ended before [DONE]"
+            if event == "[DONE]":
+                return events
+            events.append(event)
+
+    def close(self):
+        self.sock.close()
+
+
+def _solo_tokens(engine, uid: int, payload: dict, key_seed: int):
+    """Reference: the same request alone through scheduler.run()."""
+    sched = ContinuousBatchingScheduler(
+        engine, max_batch=1, key=jax.random.PRNGKey(key_seed)
+    )
+    req = Request(
+        uid=uid,
+        prompt=payload["prompt"],
+        max_new_tokens=payload["max_new_tokens"],
+        temperature=payload.get("temperature", 0.0),
+        top_p=payload.get("top_p", 1.0),
+    )
+    return sched.run([req])[uid].tokens
+
+
+# -- request validation (no engine) -------------------------------------------
+
+
+def test_parse_generate_body_validation():
+    fields = parse_generate_body(
+        json.dumps({"prompt": [1, 2, 3]}).encode(),
+        default_max_new_tokens=8,
+        default_temperature=0.5,
+        default_top_p=0.9,
+    )
+    assert fields["prompt"] == [1, 2, 3]
+    assert fields["max_new_tokens"] == 8
+    assert fields["temperature"] == 0.5
+    assert fields["top_p"] == 0.9
+    assert fields["stream"] is True
+    assert fields["deadline_s"] is None
+
+    bad = [
+        b"not json",
+        b"[1, 2]",
+        json.dumps({}).encode(),
+        json.dumps({"prompt": "text"}).encode(),
+        json.dumps({"prompt": [1, True]}).encode(),
+        json.dumps({"prompt": [1], "max_new_tokens": 0}).encode(),
+        json.dumps({"prompt": [1], "temperature": -0.1}).encode(),
+        json.dumps({"prompt": [1], "top_p": 0.0}).encode(),
+        json.dumps({"prompt": [1], "top_p": 1.5}).encode(),
+        json.dumps({"prompt": [1], "stream": "yes"}).encode(),
+        json.dumps({"prompt": [1], "deadline_s": -1}).encode(),
+    ]
+    for body in bad:
+        with pytest.raises(BadRequest):
+            parse_generate_body(
+                body, default_max_new_tokens=8, default_temperature=0.0, default_top_p=1.0
+            )
+
+
+# -- determinism over HTTP ----------------------------------------------------
+
+
+def test_streamed_tokens_match_scheduler_run(engine):
+    """Acceptance: concurrent sampled HTTP streams produce exactly the tokens
+    ``scheduler.run()`` produces for the same (uid, key) — batch composition
+    and transport change nothing."""
+    key_seed = 7
+    payloads = [
+        {"prompt": [1 + i, 2, 3], "max_new_tokens": 6, "temperature": 0.9}
+        for i in range(3)
+    ]
+    results = {}
+
+    def post(port, payload):
+        tokens, final = _generate(port, payload)
+        results[final["uid"]] = (payload, tokens, final)
+
+    with _Server(engine, max_batch=2, max_queue=4, key_seed=key_seed) as server:
+        threads = [
+            threading.Thread(target=post, args=(server.port, p)) for p in payloads
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+    assert sorted(results) == [0, 1, 2]
+    for uid, (payload, tokens, final) in results.items():
+        assert final["finish_reason"] == "length"
+        assert final["tokens"] == tokens, "stream diverged from the finish record"
+        assert tokens == _solo_tokens(engine, uid, payload, key_seed)
+
+
+def test_unary_response_matches_scheduler_run(engine):
+    payload = {"prompt": [9, 8, 7], "max_new_tokens": 5, "stream": False}
+    with _Server(engine, max_batch=1, key_seed=3) as server:
+        status, _, body = _http(server.port, "POST", "/v1/generate", payload)
+    assert status == 200
+    record = json.loads(body)
+    assert record["finish_reason"] == "length"
+    assert record["tokens"] == _solo_tokens(engine, record["uid"], payload, 3)
+
+
+# -- error paths and introspection endpoints ----------------------------------
+
+
+def test_http_error_paths_and_endpoints(engine):
+    with _Server(engine, max_batch=1) as server:
+        port = server.port
+        status, _, body = _http(port, "POST", "/v1/generate", b"not json")
+        assert status == 400 and b"JSON" in body
+        status, _, body = _http(port, "POST", "/v1/generate", {"prompt": []})
+        assert status == 400 and b"prompt" in body
+        # capacity violations surface as 400 before admission, not as a
+        # decode-loop crash later
+        status, _, body = _http(
+            port, "POST", "/v1/generate",
+            {"prompt": [1] * 16, "max_new_tokens": CACHE},
+        )
+        assert status == 400 and b"cache entries" in body
+        status, _, _ = _http(port, "GET", "/v1/generate")
+        assert status == 405
+        status, _, _ = _http(port, "GET", "/no/such/route")
+        assert status == 404
+        # malformed request line -> 400, not a hung connection
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as sock:
+            sock.sendall(b"garbage\r\n\r\n")
+            data = b""
+            while True:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+        assert b"400" in data.split(b"\r\n", 1)[0]
+
+        status, _, body = _http(port, "GET", "/healthz")
+        assert status == 200
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["max_batch"] == 1 and health["max_queue"] == 4
+        status, _, body = _http(port, "GET", "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert 'relora_serve_http_requests_total{route="healthz"} 1' in text
+        assert 'relora_serve_rejected_total{reason="bad_request"}' in text
+
+
+# -- flow control -------------------------------------------------------------
+
+
+def test_overload_sheds_load_with_429(engine):
+    """max_batch=1 + max_queue=1: one request decoding, one waiting; the
+    third is rejected with 429 + Retry-After while the first keeps
+    streaming — in-system work stays bounded under overload."""
+    with _Server(engine, max_batch=1, max_queue=1, retry_after_s=2.0) as server:
+        port = server.port
+        a = _Stream(port, {"prompt": [1, 2], "max_new_tokens": 300})
+        assert a.status == 200
+        first = a.next_event()
+        assert first["index"] == 0  # A holds the decode slot
+        b = _Stream(port, {"prompt": [3, 4], "max_new_tokens": 50})
+        assert b.status == 200  # B accepted: it fills the admission queue
+
+        status, headers, body = _http(
+            port, "POST", "/v1/generate", {"prompt": [5, 6], "max_new_tokens": 4}
+        )
+        assert status == 429, body
+        assert headers.get("retry-after") == "2"
+        assert b"admission queue full" in body
+
+        # the reject did not disturb the in-flight stream
+        assert a.next_event()["token"] is not None
+
+        status, _, body = _http(port, "GET", "/metrics")
+        assert 'relora_serve_rejected_total{reason="queue_full"} 1' in body.decode()
+        a.close()
+        b.close()
+
+
+def test_deadline_expiry_returns_partial_output(engine):
+    """A request that cannot finish inside deadline_s stops at a step
+    boundary with its partial tokens and finish_reason "timeout"."""
+    with _Server(engine, max_batch=1) as server:
+        tokens, final = _generate(
+            server.port,
+            {"prompt": [1, 2, 3], "max_new_tokens": 480, "deadline_s": 0.25},
+        )
+    assert final["finish_reason"] == "timeout"
+    assert 0 < len(tokens) < 480
+    assert final["tokens"] == tokens
+
+
+def test_client_disconnect_frees_slot(engine):
+    """Hanging up mid-stream cancels the request at the next step boundary:
+    the slot frees, metrics record the disconnect, and the next request gets
+    the slot."""
+    with _Server(engine, max_batch=1) as server:
+        port = server.port
+        a = _Stream(port, {"prompt": [1, 2], "max_new_tokens": 400})
+        assert a.next_event()["index"] == 0
+        a.close()
+
+        deadline = time.monotonic() + 30.0
+        freed = False
+        while time.monotonic() < deadline:
+            _, _, body = _http(port, "GET", "/metrics")
+            text = body.decode()
+            if (
+                'relora_serve_requests_finished_total{reason="cancelled"} 1' in text
+                and "relora_serve_active_slots 0" in text
+            ):
+                freed = True
+                break
+            time.sleep(0.05)
+        assert freed, "slot was not freed after client disconnect"
+        assert "relora_serve_disconnects_total 1" in text
+
+        tokens, final = _generate(port, {"prompt": [7, 8], "max_new_tokens": 4})
+        assert final["finish_reason"] == "length" and len(tokens) == 4
+
+
+def test_drain_finishes_in_flight_and_rejects_new(engine):
+    """begin_drain (the SIGTERM handler's body): in-flight streams run to
+    completion, new requests get 503 + Retry-After, /healthz flips to
+    draining, and serve_forever returns."""
+    holder = _Server(engine, max_batch=1)
+    with holder as server:
+        port = server.port
+        a = _Stream(port, {"prompt": [1, 2], "max_new_tokens": 60})
+        assert a.next_event()["index"] == 0
+
+        server.begin_drain()
+        status, _, body = _http(port, "GET", "/healthz")
+        assert status == 503 and json.loads(body)["status"] == "draining"
+        status, headers, _ = _http(
+            port, "POST", "/v1/generate", {"prompt": [9], "max_new_tokens": 2}
+        )
+        assert status == 503 and "retry-after" in headers
+
+        events = a.read_to_done()
+        final = events[-1]
+        assert final["finish_reason"] == "length"
+        assert len(final["tokens"]) == 60
+        a.close()
+        assert server.drained.wait(60), "model thread did not exit after drain"
+        holder.thread.join(60)
+        assert not holder.thread.is_alive(), "serve_forever did not return"

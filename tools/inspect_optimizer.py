@@ -66,4 +66,11 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:
+        # stdout piped into `head` that already exited (smoke_test.sh does
+        # this); the truncated output is what the reader asked for
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), 1)
