@@ -60,6 +60,12 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
     "relora_tpu/serve/server.py": [
         "GenerateServer._model_loop",
     ],
+    # the tracer/metrics/flight-recorder run INSIDE the hot loops above (a
+    # few spans per decode step / train update) — stdlib-only by design;
+    # marking them hot keeps device syncs and hot-loop footguns out
+    "relora_tpu/obs/tracer.py": [""],
+    "relora_tpu/obs/metrics.py": [""],
+    "relora_tpu/obs/flight.py": [""],
 }
 
 HOT_MARKER = "relora-lint: hot-path"

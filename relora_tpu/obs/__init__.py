@@ -1,0 +1,39 @@
+"""relora_tpu.obs — unified observability: span tracing, shared metrics
+registry, flight recorder, and MFU helpers.
+
+Stdlib-only (``mfu`` imports jax lazily and only for device detection);
+safe to import from the serving front-end, the trainer, and signal
+handlers.  See docs/observability.md.
+"""
+
+from relora_tpu.obs.flight import FlightRecorder, configure, default_recorder, dump_on_fault
+from relora_tpu.obs.metrics import LATENCY_BUCKETS, Histogram, MetricsRegistry
+from relora_tpu.obs.mfu import peak_flops, step_flops_from_cost_analysis
+from relora_tpu.obs.tracer import (
+    NoopTracer,
+    Span,
+    Tracer,
+    chrome_trace_events,
+    default_tracer,
+    new_trace_id,
+    set_default_tracer,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "configure",
+    "default_recorder",
+    "dump_on_fault",
+    "LATENCY_BUCKETS",
+    "Histogram",
+    "MetricsRegistry",
+    "peak_flops",
+    "step_flops_from_cost_analysis",
+    "NoopTracer",
+    "Span",
+    "Tracer",
+    "chrome_trace_events",
+    "default_tracer",
+    "new_trace_id",
+    "set_default_tracer",
+]

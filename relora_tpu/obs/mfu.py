@@ -1,0 +1,93 @@
+"""Peak-FLOPs detection and live MFU from compiled-step cost analysis.
+
+Two MFU paths share this module:
+
+- ``bench.py`` / ``utils/benchlib.py``: offline throughput benches that
+  previously hardcoded the v5e peak (``PEAK_FLOPS_V5E``).
+- the trainer's **live MFU gauge**: per-update MFU computed from the actual
+  FLOPs XLA reports for the compiled train step (``lower(...).cost_analysis()
+  ['flops']``), falling back to the 6ND approximation when cost analysis is
+  unavailable.  cost_analysis counts what the program *really* does —
+  attention scores, remat recomputation, LoRA factor matmuls — where 6ND is
+  a dense-transformer estimate, so the two can legitimately differ by tens
+  of percent under remat.
+
+Peak-FLOPs resolution order: ``RELORA_TPU_PEAK_FLOPS`` env override, then a
+``device_kind`` substring match against :data:`PEAK_FLOPS_BY_KIND`, then the
+v5e default (keeps historical bench numbers comparable when detection
+fails, e.g. on the CPU backend).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = [
+    "PEAK_FLOPS_BY_KIND",
+    "PEAK_FLOPS_DEFAULT",
+    "peak_flops",
+    "step_flops_from_cost_analysis",
+]
+
+#: bf16 peak FLOPs/s of one chip, keyed by a lowercase substring of
+#: ``jax.devices()[0].device_kind``.  Order matters: first match wins, so
+#: longer / more specific kinds come before their prefixes (v5e before v5,
+#: v6e before v6).
+PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12),        # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),        # aka v5 lite
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("h100", 989e12),       # dense bf16, SXM
+    ("a100", 312e12),
+)
+
+#: historical default (one TPU v5e chip) — used when the device kind is
+#: unrecognized, e.g. the CPU backend in tests
+PEAK_FLOPS_DEFAULT = 197e12
+
+
+def peak_flops(device: Optional[Any] = None) -> float:
+    """Peak bf16 FLOPs/s for ``device`` (default: ``jax.devices()[0]``).
+
+    ``RELORA_TPU_PEAK_FLOPS`` overrides everything — the escape hatch for
+    hardware this table has not met.
+    """
+    env = os.environ.get("RELORA_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception:
+            return PEAK_FLOPS_DEFAULT
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for needle, flops in PEAK_FLOPS_BY_KIND:
+        if needle in kind:
+            return flops
+    return PEAK_FLOPS_DEFAULT
+
+
+def step_flops_from_cost_analysis(cost: Any) -> Optional[float]:
+    """Extract total FLOPs from a jax cost-analysis result.
+
+    Handles both shapes jax returns across versions: ``lowered.cost_analysis()``
+    gives a dict, ``compiled.cost_analysis()`` gives a list of per-computation
+    dicts.  Returns None when no positive 'flops' entry exists (e.g. some
+    backends report nothing), signalling the caller to fall back to 6ND.
+    """
+    if cost is None:
+        return None
+    if isinstance(cost, dict):
+        cost = [cost]
+    try:
+        total = sum(float(c.get("flops", 0.0)) for c in cost if isinstance(c, dict))
+    except (TypeError, ValueError):
+        return None
+    return total if total > 0 else None

@@ -1,0 +1,412 @@
+"""Span tracer: attributable wall-clock timing for training and serving.
+
+The repo's two timing views before this module were aggregate (TTFT/TPOT
+histograms on ``/metrics``, throughput lines in metrics.jsonl) or
+device-level (``StepProfiler``'s XLA traces).  Neither can answer "where did
+*this* request's 2 s TTFT go?" or "what fraction of a train step is host
+metric pulls?".  Spans fill that gap: named wall-clock intervals with a
+``trace_id`` (one per HTTP request / training run), a ``parent_id`` (so
+phases nest into a tree), and free-form attributes.
+
+Design constraints, in priority order:
+
+1. **Hot-loop safe.**  ``Tracer.span`` is called once or a handful of times
+   per decode step / train update; its cost is two ``time.monotonic()``
+   calls, a few dict stores, and one lock-guarded deque append — single-digit
+   microseconds against multi-millisecond steps (measured: ``bench.py --mode
+   obs_overhead``, budget <1% of step time).  No I/O on the hot path unless a
+   JSONL sink is explicitly configured.
+2. **Stdlib-only and jax-free**, like serve/admission and analysis/: the
+   tracer must import fast and run in the asyncio front-end, the model
+   thread, and the signal handler that dumps the flight recorder.
+3. **Thread-safe with cross-thread spans.**  Nesting uses a *per-thread*
+   stack (the trainer's single-threaded loop gets parent/child links for
+   free); spans that start on one thread and end on another (a request's
+   queue-wait starts in an asyncio handler and ends in the model thread) use
+   the explicit ``start_span()``/``Span.end()`` API.
+
+Finished spans land in a :class:`~relora_tpu.obs.flight.FlightRecorder`
+ring buffer (crash forensics) and, when configured, a JSONL stream.  Both
+export to Chrome/Perfetto trace-event JSON (``chrome_trace_events``) so
+spans overlay with the XLA timelines ``StepProfiler`` already writes —
+``chrome://tracing`` or https://ui.perfetto.dev open either.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "NoopTracer",
+    "new_trace_id",
+    "chrome_trace_events",
+    "default_tracer",
+    "set_default_tracer",
+]
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id (also used as HTTP X-Request-Id)."""
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One named wall-clock interval.  Mutable until :meth:`end` is called,
+    which records it with the owning tracer exactly once."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "t_start", "t_end",
+        "attrs", "thread", "_tracer",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: Optional[str],
+        t_start: float,
+        attrs: Dict[str, Any],
+        tracer: "Tracer",
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t_start = t_start
+        self.t_end: Optional[float] = None
+        self.attrs = attrs
+        self.thread = threading.current_thread().name
+        self._tracer = tracer
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        if self.t_end is None:
+            return None
+        return self.t_end - self.t_start
+
+    def end(self) -> float:
+        """Close the span and record it.  Returns the duration in seconds.
+        Idempotent: a second call returns the recorded duration."""
+        if self.t_end is None:
+            self.t_end = self._tracer.clock()
+            self._tracer._record(self)
+        return self.t_end - self.t_start
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "t_start": self.t_start,
+            "t_end": self.t_end,
+            "dur_s": None if self.t_end is None else self.t_end - self.t_start,
+            "thread": self.thread,
+            "service": self._tracer.service,
+            "attrs": self.attrs,
+        }
+
+
+class Tracer:
+    """Factory and sink for spans of one service ("train", "serve", ...).
+
+    ``span()`` is the context-manager API with automatic per-thread nesting;
+    ``start_span()``/``Span.end()`` is the manual API for spans that cross
+    threads (they do not touch the nesting stack).  ``event()`` records an
+    instant (zero-duration) marker.
+    """
+
+    def __init__(
+        self,
+        service: str = "app",
+        *,
+        recorder=None,
+        jsonl_path: Optional[str] = None,
+        clock=time.monotonic,
+    ):
+        self.service = service
+        self.clock = clock
+        self.enabled = True
+        # epoch anchor: wall time at construction minus the monotonic origin,
+        # so exports can map monotonic stamps to wall clock
+        self.wall_anchor = time.time() - clock()
+        self.default_trace_id = new_trace_id()
+        if recorder is None:
+            from relora_tpu.obs.flight import default_recorder
+
+            recorder = default_recorder()
+        self.recorder = recorder
+        self._ids = itertools.count(1)  # next() is atomic in CPython
+        self._local = threading.local()
+        self._jsonl_lock = threading.Lock()
+        self._jsonl_path = jsonl_path
+        self._jsonl_fh = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)), exist_ok=True)
+            self._jsonl_fh = open(jsonl_path, "a")
+
+    # -- internals -----------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _next_id(self) -> str:
+        return f"s{next(self._ids):06x}"
+
+    def _record(self, span: Span) -> None:
+        d = span.to_dict()
+        self.recorder.add_span(d)
+        fh = self._jsonl_fh
+        if fh is not None:
+            with self._jsonl_lock:
+                fh.write(json.dumps(d) + "\n")
+                fh.flush()
+
+    # -- public API ----------------------------------------------------------
+
+    def start_span(
+        self,
+        name: str,
+        *,
+        trace_id: Optional[str] = None,
+        parent: Optional[Span] = None,
+        **attrs: Any,
+    ) -> Span:
+        """Manual span (cross-thread capable): caller must call ``end()``.
+        Does not join the per-thread nesting stack, but *reads* it: with no
+        explicit parent/trace, the calling thread's current span becomes the
+        parent."""
+        stack = self._stack()
+        top = stack[-1] if stack else None
+        if parent is None:
+            parent = top
+        if trace_id is None:
+            trace_id = parent.trace_id if parent is not None else self.default_trace_id
+        return Span(
+            name,
+            trace_id,
+            self._next_id(),
+            parent.span_id if parent is not None else None,
+            self.clock(),
+            attrs,
+            self,
+        )
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        *,
+        trace_id: Optional[str] = None,
+        parent: Optional[Span] = None,
+        **attrs: Any,
+    ):
+        """Context-managed span with automatic nesting: children opened in
+        the same thread inside this block parent to it."""
+        sp = self.start_span(name, trace_id=trace_id, parent=parent, **attrs)
+        stack = self._stack()
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            # pop by identity: an exception inside a nested manual pop can't
+            # desync the stack
+            if stack and stack[-1] is sp:
+                stack.pop()
+            elif sp in stack:
+                stack.remove(sp)
+            sp.end()
+
+    def current_span(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def event(self, name: str, *, trace_id: Optional[str] = None, **attrs: Any) -> None:
+        """Instant marker (Chrome phase "i"): zero-duration, recorded
+        immediately."""
+        top = self.current_span()
+        if trace_id is None:
+            trace_id = top.trace_id if top is not None else self.default_trace_id
+        self.recorder.add_event(
+            {
+                "name": name,
+                "trace_id": trace_id,
+                "parent_id": top.span_id if top is not None else None,
+                "t": self.clock(),
+                "thread": threading.current_thread().name,
+                "service": self.service,
+                "attrs": attrs,
+            }
+        )
+
+    def close(self) -> None:
+        fh, self._jsonl_fh = self._jsonl_fh, None
+        if fh is not None:
+            with self._jsonl_lock:
+                fh.close()
+
+
+class _NoopSpan:
+    __slots__ = ()
+    name = trace_id = span_id = parent_id = thread = ""
+    parent_id = None
+    t_start = t_end = 0.0
+    duration_s = 0.0
+    attrs: Dict[str, Any] = {}
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def end(self) -> float:
+        return 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {}
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _NoopCtx:
+    __slots__ = ()
+
+    def __enter__(self) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NOOP_CTX = _NoopCtx()
+
+
+class NoopTracer:
+    """API-compatible tracer that records nothing — the control arm of the
+    overhead bench and the disabled state (``RELORA_TPU_TRACE=0``)."""
+
+    enabled = False
+    service = "noop"
+    clock = staticmethod(time.monotonic)
+    wall_anchor = 0.0
+    default_trace_id = "0" * 16
+
+    def span(self, name: str, **kw: Any) -> _NoopCtx:
+        return _NOOP_CTX
+
+    def start_span(self, name: str, **kw: Any) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def current_span(self) -> None:
+        return None
+
+    def event(self, name: str, **kw: Any) -> None:
+        return None
+
+    def close(self) -> None:
+        return None
+
+
+def chrome_trace_events(
+    spans: Iterable[Dict[str, Any]],
+    events: Iterable[Dict[str, Any]] = (),
+    *,
+    pid: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Convert recorded span/event dicts to Chrome trace-event JSON objects
+    (the ``traceEvents`` list).  Timestamps are monotonic microseconds — the
+    same clock family the XLA profiler emits, so loading both into Perfetto
+    lines the host phases up against device activity."""
+    pid = os.getpid() if pid is None else pid
+    out: List[Dict[str, Any]] = []
+    tids: Dict[str, int] = {}
+
+    def tid_of(thread: str) -> int:
+        if thread not in tids:
+            tids[thread] = len(tids) + 1
+        return tids[thread]
+
+    for s in spans:
+        if s.get("t_end") is None:
+            continue
+        out.append(
+            {
+                "name": s["name"],
+                "cat": s.get("service", "obs"),
+                "ph": "X",
+                "ts": round(s["t_start"] * 1e6, 3),
+                "dur": round((s["t_end"] - s["t_start"]) * 1e6, 3),
+                "pid": pid,
+                "tid": tid_of(s.get("thread", "main")),
+                "args": {
+                    "trace_id": s.get("trace_id"),
+                    "span_id": s.get("span_id"),
+                    "parent_id": s.get("parent_id"),
+                    **(s.get("attrs") or {}),
+                },
+            }
+        )
+    for e in events:
+        out.append(
+            {
+                "name": e["name"],
+                "cat": e.get("service", "obs"),
+                "ph": "i",
+                "s": "t",
+                "ts": round(e["t"] * 1e6, 3),
+                "pid": pid,
+                "tid": tid_of(e.get("thread", "main")),
+                "args": {"trace_id": e.get("trace_id"), **(e.get("attrs") or {})},
+            }
+        )
+    for thread, tid in tids.items():
+        out.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": thread},
+            }
+        )
+    return out
+
+
+# -- process default ---------------------------------------------------------
+
+_DEFAULT: Optional[Tracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_tracer() -> Tracer:
+    """Lazy process-wide tracer (service "app").  Subsystems that care about
+    their service label (Trainer, GenerateServer) build their own; library
+    code that just wants to emit a span uses this."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = Tracer(service="app")
+        return _DEFAULT
+
+
+def set_default_tracer(tracer: Tracer) -> Optional[Tracer]:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        prev, _DEFAULT = _DEFAULT, tracer
+        return prev

@@ -18,8 +18,9 @@ thread boundary between the asyncio request handlers and that model thread:
   finish callbacks (which hop onto the event loop via
   ``loop.call_soon_threadsafe``) and a ``cancelled`` event the handler sets
   on client disconnect so the model thread can free the slot.
-- ``ServeMetrics`` — thread-safe counters, gauges, and fixed-bucket
-  histograms behind the ``/metrics`` endpoint (Prometheus text exposition),
+- ``ServeMetrics`` — the serving-flavoured view of the shared
+  :class:`relora_tpu.obs.metrics.MetricsRegistry` (thread-safe counters,
+  gauges, and fixed-bucket histograms behind the ``/metrics`` endpoint),
   fed from both sides: handlers count requests and rejects, the model
   thread observes TTFT / per-token latency and updates the queue/slot
   gauges every step.
@@ -30,15 +31,25 @@ front-end must import fast and run anywhere the linter runs.
 
 from __future__ import annotations
 
-import bisect
 import dataclasses
 import itertools
 import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Optional
 
+from relora_tpu.obs.metrics import LATENCY_BUCKETS, Histogram, MetricsRegistry
 from relora_tpu.serve.scheduler import Completion, Request
+
+__all__ = [
+    "QueueFull",
+    "Draining",
+    "Ticket",
+    "AdmissionController",
+    "ServeMetrics",
+    "LATENCY_BUCKETS",  # re-exported from obs.metrics for existing importers
+    "Histogram",
+]
 
 
 class QueueFull(Exception):
@@ -61,6 +72,9 @@ class Ticket:
     cancelled: threading.Event = dataclasses.field(default_factory=threading.Event)
     t_enqueue: float = dataclasses.field(default_factory=time.monotonic)
     t_last_token: Optional[float] = None  # model thread only; TPOT bookkeeping
+    trace_id: Optional[str] = None  # request id; X-Request-Id + span trace_id
+    span: Optional[Any] = None  # root "request" span; ended at finish
+    queue_span: Optional[Any] = None  # "queue_wait": admit -> model-thread claim
 
 
 class AdmissionController:
@@ -120,111 +134,15 @@ class AdmissionController:
 
 
 # -- metrics -----------------------------------------------------------------
-
-#: latency histogram buckets (seconds) — log-spaced over the TTFT/TPOT range
-#: a CPU dev box to a TPU pod actually spans
-LATENCY_BUCKETS: Tuple[float, ...] = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
-)
+# Histogram / LATENCY_BUCKETS / the registry implementation live in
+# relora_tpu.obs.metrics (shared with the trainer); re-exported above.
 
 
-class Histogram:
-    """Fixed-bucket cumulative histogram (Prometheus semantics): counts per
-    upper bound, plus sum and count for rate/mean queries."""
-
-    def __init__(self, buckets: Tuple[float, ...] = LATENCY_BUCKETS):
-        self.bounds = tuple(sorted(buckets))
-        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf
-        self.total = 0.0
-        self.count = 0
-
-    def observe(self, value: float) -> None:
-        self.counts[bisect.bisect_left(self.bounds, value)] += 1
-        self.total += value
-        self.count += 1
-
-
-class ServeMetrics:
-    """Thread-safe serving metrics with Prometheus text exposition.
-
-    Counters take an optional label pair (one level is all the cardinality
-    the front-end needs); gauges are set-to-latest; histograms observe
-    seconds.  ``render()`` produces the ``/metrics`` body; ``snapshot()``
-    returns a flat dict for JSONL / tests.
-    """
+class ServeMetrics(MetricsRegistry):
+    """Serving metrics: the shared registry under the ``relora_serve``
+    namespace.  ``render()``/``snapshot()``/counter semantics are the
+    registry's — the ``/metrics`` body is byte-identical to the
+    pre-extraction renderer (pinned by tests/test_obs.py's golden test)."""
 
     def __init__(self, namespace: str = "relora_serve"):
-        self.namespace = namespace
-        self._lock = threading.Lock()
-        self._counters: Dict[Tuple[str, Optional[Tuple[str, str]]], int] = {}
-        self._gauges: Dict[str, float] = {}
-        self._hists: Dict[str, Histogram] = {}
-
-    def inc(self, name: str, label: Optional[Tuple[str, str]] = None, by: int = 1) -> None:
-        with self._lock:
-            key = (name, label)
-            self._counters[key] = self._counters.get(key, 0) + by
-
-    def set_gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self._gauges[name] = value
-
-    def observe(self, name: str, value: float) -> None:
-        with self._lock:
-            hist = self._hists.get(name)
-            if hist is None:
-                hist = self._hists[name] = Histogram()
-            hist.observe(value)
-
-    def counter_value(self, name: str, label: Optional[Tuple[str, str]] = None) -> int:
-        with self._lock:
-            return self._counters.get((name, label), 0)
-
-    def gauge_value(self, name: str, default: float = 0.0) -> float:
-        with self._lock:
-            return self._gauges.get(name, default)
-
-    def snapshot(self) -> Dict[str, float]:
-        """Flat dict view: counters (labels joined with '.'), gauges, and
-        histogram count/sum — the shape MetricsLogger.log expects."""
-        with self._lock:
-            out: Dict[str, float] = {}
-            for (name, label), value in sorted(self._counters.items()):
-                key = name if label is None else f"{name}.{label[1]}"
-                out[key] = value
-            out.update(self._gauges)
-            for name, hist in self._hists.items():
-                out[f"{name}_count"] = hist.count
-                out[f"{name}_sum"] = round(hist.total, 6)
-            return out
-
-    def render(self) -> str:
-        """Prometheus text exposition (version 0.0.4)."""
-        with self._lock:
-            lines: List[str] = []
-            seen_types = set()
-            for (name, label), value in sorted(self._counters.items()):
-                full = f"{self.namespace}_{name}"
-                if full not in seen_types:
-                    lines.append(f"# TYPE {full} counter")
-                    seen_types.add(full)
-                if label is None:
-                    lines.append(f"{full} {value}")
-                else:
-                    lines.append(f'{full}{{{label[0]}="{label[1]}"}} {value}')
-            for name, value in sorted(self._gauges.items()):
-                full = f"{self.namespace}_{name}"
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {value:g}")
-            for name, hist in sorted(self._hists.items()):
-                full = f"{self.namespace}_{name}"
-                lines.append(f"# TYPE {full} histogram")
-                cumulative = 0
-                for bound, count in zip(hist.bounds, hist.counts):
-                    cumulative += count
-                    lines.append(f'{full}_bucket{{le="{bound:g}"}} {cumulative}')
-                cumulative += hist.counts[-1]
-                lines.append(f'{full}_bucket{{le="+Inf"}} {cumulative}')
-                lines.append(f"{full}_sum {hist.total:.6f}")
-                lines.append(f"{full}_count {hist.count}")
-            return "\n".join(lines) + "\n"
+        super().__init__(namespace=namespace)

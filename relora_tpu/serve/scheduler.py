@@ -49,12 +49,13 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from relora_tpu.obs.tracer import NoopTracer
 from relora_tpu.serve.engine import InferenceEngine, bucket_length
 from relora_tpu.serve.sampling import SamplingParams
 from relora_tpu.utils.logging import MetricsLogger, get_logger
@@ -97,6 +98,7 @@ class _Slot:
     t_admit: float
     t_first: float
     deadline: Optional[float] = None  # absolute time.monotonic(), None = no limit
+    span: Optional[Any] = None  # per-request "decode" span; ended at retire
 
 
 class ContinuousBatchingScheduler:
@@ -111,6 +113,8 @@ class ContinuousBatchingScheduler:
         top_k: int = 0,
         metrics: Optional[MetricsLogger] = None,
         key: Optional[jax.Array] = None,
+        tracer: Optional[Any] = None,
+        obs_registry: Optional[Any] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -119,6 +123,10 @@ class ContinuousBatchingScheduler:
         self.eos_id = eos_id
         self.top_k = top_k
         self.metrics = metrics
+        # tracing defaults to no-op so the batch CLI pays nothing; the HTTP
+        # server injects its Tracer + ServeMetrics (per-phase histograms)
+        self.tracer = tracer if tracer is not None else NoopTracer()
+        self.obs_registry = obs_registry
         self.key = key if key is not None else jax.random.PRNGKey(0)
         self._step_count = 0
         self._pending: Deque[Request] = deque()
@@ -129,6 +137,7 @@ class ContinuousBatchingScheduler:
         self._deadlines: Dict[int, float] = {}
         self._on_token: Dict[int, TokenCallback] = {}
         self._on_finish: Dict[int, FinishCallback] = {}
+        self._trace_ids: Dict[int, str] = {}  # uid -> request trace id
 
     def _request_key(self, req: Request, token_index: int) -> jax.Array:
         # keyed by (uid, token index): a request's sample stream does not
@@ -161,6 +170,7 @@ class ContinuousBatchingScheduler:
         on_token: Optional[TokenCallback] = None,
         on_finish: Optional[FinishCallback] = None,
         deadline: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Queue a request for admission at the next ``step()``.
 
@@ -168,7 +178,9 @@ class ContinuousBatchingScheduler:
         sampled (index 0 is the prefill's first token); ``on_finish`` fires
         exactly once with the Completion.  ``deadline`` is an absolute
         ``time.monotonic()`` bound — a request still decoding past it
-        finishes with its partial output and reason ``"timeout"``."""
+        finishes with its partial output and reason ``"timeout"``.
+        ``trace_id`` threads the caller's request id onto every phase span
+        this request produces (prefill/insert/decode)."""
         self.validate_request(req)
         if req.uid in self._deadlines or req.uid in self._on_finish or any(
             r.uid == req.uid for r in self._pending
@@ -180,7 +192,13 @@ class ContinuousBatchingScheduler:
             self._on_token[req.uid] = on_token
         if on_finish is not None:
             self._on_finish[req.uid] = on_finish
+        if trace_id is not None:
+            self._trace_ids[req.uid] = trace_id
         self._pending.append(req)
+
+    def _observe(self, name: str, value: float) -> None:
+        if self.obs_registry is not None:
+            self.obs_registry.observe(name, value)
 
     def cancel(self, uid: int, reason: str = "cancelled") -> Optional[Completion]:
         """Free a request's slot (or drop it from the pending queue) and
@@ -223,15 +241,22 @@ class ContinuousBatchingScheduler:
             return finished
 
         # -- one decode step over all slots ----------------------------------
-        logits, self._cache = self.engine.decode(
-            self._cache,
-            jnp.asarray(self._tokens)[:, None],
-            jnp.asarray(self._positions)[:, None],
-        )
-        self._step_count += 1
-        # one bulk pull for the whole batch, then plain Python ints —
-        # per-slot int(next_tokens[i]) would be a device sync per row
-        next_tokens = self._sample_rows(logits, self._slots).tolist()
+        # batch-level span (several requests share it): dispatch + the bulk
+        # token pull, which is the step's device sync point
+        t_decode = time.monotonic()
+        with self.tracer.span(
+            "decode_step", step=self._step_count, active_slots=self.active_slots
+        ):
+            logits, self._cache = self.engine.decode(
+                self._cache,
+                jnp.asarray(self._tokens)[:, None],
+                jnp.asarray(self._positions)[:, None],
+            )
+            self._step_count += 1
+            # one bulk pull for the whole batch, then plain Python ints —
+            # per-slot int(next_tokens[i]) would be a device sync per row
+            next_tokens = self._sample_rows(logits, self._slots).tolist()
+        self._observe("decode_step_seconds", time.monotonic() - t_decode)
         for slot_idx, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -303,6 +328,10 @@ class ContinuousBatchingScheduler:
                 t_admit=t_admit,
                 t_first=time.monotonic(),
                 deadline=deadline,
+                # the request's decode phase: open until EOS/budget/cancel
+                span=self.tracer.start_span(
+                    "decode", trace_id=self._trace_ids.get(req.uid), uid=req.uid
+                ),
             )
             self._tokens[slot_idx] = first
             self._positions[slot_idx] = len(req.prompt)
@@ -321,16 +350,29 @@ class ContinuousBatchingScheduler:
         T = min(bucket_length(L), self.engine.cache_size)
         ids = np.zeros((1, T), np.int32)
         ids[0, :L] = np.asarray(req.prompt, np.int32)
-        logits, pcache = self.engine.prefill(jnp.asarray(ids))
-        cache = self.engine.insert(cache, pcache, slot_idx)
-        first = self.engine._sample(
-            logits[:, L - 1, :],
-            self._request_key(req, 0),
-            temperature=req.temperature,
-            top_k=self.top_k,
-            top_p=req.top_p,
-        )
-        return cache, int(np.asarray(first)[0])
+        tid = self._trace_ids.get(req.uid)
+        # the prefill span includes the first-token sample pull: that host
+        # pull is the sync point, so the span covers real compute, not just
+        # async dispatch
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "prefill", trace_id=tid, uid=req.uid, prompt_tokens=L, bucket=T
+        ):
+            logits, pcache = self.engine.prefill(jnp.asarray(ids))
+            first = self.engine._sample(
+                logits[:, L - 1, :],
+                self._request_key(req, 0),
+                temperature=req.temperature,
+                top_k=self.top_k,
+                top_p=req.top_p,
+            )
+            first_id = int(np.asarray(first)[0])
+        t1 = time.monotonic()
+        self._observe("prefill_seconds", t1 - t0)
+        with self.tracer.span("insert", trace_id=tid, uid=req.uid, slot=slot_idx):
+            cache = self.engine.insert(cache, pcache, slot_idx)
+        self._observe("insert_seconds", time.monotonic() - t1)
+        return cache, first_id
 
     def _sample_rows(self, logits, slots) -> np.ndarray:
         temps = np.zeros(self.max_batch, np.float32)
@@ -390,6 +432,11 @@ class ContinuousBatchingScheduler:
             latency_s=now - slot.t_admit,
         )
         self._slots[slot_idx] = None  # evict: slot is free, nothing recompiles
+        if slot.span is not None:
+            slot.span.set(
+                finish_reason=reason, output_tokens=len(completion.tokens)
+            ).end()
+            self._observe("decode_seconds", now - slot.t_first)
         if self.metrics is not None:
             decode_s = max(now - slot.t_first, 1e-9)
             self.metrics.log(
@@ -437,6 +484,7 @@ class ContinuousBatchingScheduler:
     def _finalize(self, completion: Completion) -> None:
         self._deadlines.pop(completion.uid, None)
         self._on_token.pop(completion.uid, None)
+        self._trace_ids.pop(completion.uid, None)
         callback = self._on_finish.pop(completion.uid, None)
         if callback is None:
             return

@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Set, Tuple
 
+from relora_tpu.obs.tracer import NoopTracer, Tracer, new_trace_id
 from relora_tpu.serve.admission import (
     AdmissionController,
     Draining,
@@ -151,6 +152,7 @@ class GenerateServer:
         default_top_p: float = 1.0,
         retry_after_s: float = 1.0,
         metrics: Optional[MetricsLogger] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.scheduler = scheduler
         self.host = host
@@ -158,6 +160,15 @@ class GenerateServer:
         self.admission = AdmissionController(max_queue, retry_after_s=retry_after_s)
         self.stats = ServeMetrics()
         self.metrics = metrics
+        self.tracer = tracer if tracer is not None else Tracer(service="serve")
+        # thread the server's tracer + registry into the scheduler so
+        # prefill/insert/decode spans carry the same request trace ids and
+        # the per-phase histograms land on this /metrics endpoint (a
+        # scheduler built with its own tracer/registry keeps them)
+        if isinstance(scheduler.tracer, NoopTracer):
+            scheduler.tracer = self.tracer
+        if scheduler.obs_registry is None:
+            scheduler.obs_registry = self.stats
         self.default_max_new_tokens = default_max_new_tokens
         self.default_temperature = default_temperature
         self.default_top_p = default_top_p
@@ -266,9 +277,15 @@ class GenerateServer:
 
     def _claim(self, ticket: Ticket) -> None:
         """Hand one admitted ticket to the scheduler (model thread only)."""
+        # the queue-wait span opened at admission ends here, where the model
+        # thread claims the ticket (cross-thread: started on the event loop)
+        if ticket.queue_span is not None:
+            self.stats.observe("queue_wait_seconds", ticket.queue_span.end())
         if ticket.cancelled.is_set():
             # client left while the request was still queued: never admit it
             self.stats.inc("requests_finished_total", ("reason", "cancelled"))
+            if ticket.span is not None:
+                ticket.span.set(finish_reason="cancelled", output_tokens=0).end()
             ticket.on_finish(
                 Completion(
                     uid=ticket.uid,
@@ -298,6 +315,11 @@ class GenerateServer:
             self.stats.observe(
                 "e2e_latency_seconds", time.monotonic() - _t.t_enqueue
             )
+            if _t.span is not None:
+                _t.span.set(
+                    finish_reason=completion.finish_reason,
+                    output_tokens=len(completion.tokens),
+                ).end()
             _t.on_finish(completion)
 
         self.scheduler.submit(
@@ -305,6 +327,7 @@ class GenerateServer:
             on_token=on_token,
             on_finish=on_finish,
             deadline=ticket.deadline,
+            trace_id=ticket.trace_id,
         )
 
     # -- asyncio handlers ----------------------------------------------------
@@ -339,7 +362,7 @@ class GenerateServer:
             return
         if parsed is None:
             return
-        method, path, _headers, body = parsed
+        method, path, headers, body = parsed
         route = path.split("?", 1)[0]
         if route == "/healthz" and method == "GET":
             self.stats.inc("http_requests_total", ("route", "healthz"))
@@ -352,7 +375,7 @@ class GenerateServer:
             if method != "POST":
                 await _respond_json(writer, 405, {"error": "use POST"})
                 return
-            await self._handle_generate(reader, writer, body)
+            await self._handle_generate(reader, writer, body, headers)
         else:
             self.stats.inc("http_requests_total", ("route", "other"))
             await _respond_json(writer, 404, {"error": f"no route {route}"})
@@ -378,7 +401,13 @@ class GenerateServer:
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
         body: bytes,
+        headers: Optional[Dict[str, str]] = None,
     ) -> None:
+        # the request id is the span trace id AND the X-Request-Id response
+        # header: a caller-supplied header is honored (so a gateway's id
+        # threads through every phase span), otherwise one is minted here
+        rid = ((headers or {}).get("x-request-id") or "").strip() or new_trace_id()
+        rid_header = {"X-Request-Id": rid}
         try:
             fields = parse_generate_body(
                 body,
@@ -398,7 +427,7 @@ class GenerateServer:
             self.scheduler.validate_request(req)
         except (BadRequest, ValueError) as e:
             self.stats.inc("rejected_total", ("reason", "bad_request"))
-            await _respond_json(writer, 400, {"error": str(e)})
+            await _respond_json(writer, 400, {"error": str(e)}, extra_headers=rid_header)
             return
 
         loop = asyncio.get_running_loop()
@@ -415,31 +444,52 @@ class GenerateServer:
             if fields["deadline_s"] is not None
             else None
         )
+        # root span for the whole request; queue_wait opens now and is ended
+        # by the model thread when it claims the ticket (cross-thread span)
+        root = self.tracer.start_span(
+            "request", trace_id=rid, uid=req.uid, route="generate",
+            prompt_tokens=len(req.prompt),
+        )
         ticket = Ticket(
             uid=req.uid,
             request=req,
             deadline=deadline,
             on_token=lambda uid, tok, idx: post("token", tok, idx),
             on_finish=lambda completion: post("finish", completion),
+            trace_id=rid,
+            span=root,
+            queue_span=self.tracer.start_span(
+                "queue_wait", trace_id=rid, parent=root, uid=req.uid
+            ),
         )
         try:
             self.admission.try_admit(ticket)
         except QueueFull as e:
             self.stats.inc("rejected_total", ("reason", "queue_full"))
+            ticket.queue_span.set(outcome="queue_full").end()
+            root.set(finish_reason="rejected_queue_full").end()
             await _respond_json(
                 writer,
                 429,
                 {"error": str(e)},
-                extra_headers={"Retry-After": f"{self.admission.retry_after_s:.0f}"},
+                extra_headers={
+                    "Retry-After": f"{self.admission.retry_after_s:.0f}",
+                    **rid_header,
+                },
             )
             return
         except Draining as e:
             self.stats.inc("rejected_total", ("reason", "draining"))
+            ticket.queue_span.set(outcome="draining").end()
+            root.set(finish_reason="rejected_draining").end()
             await _respond_json(
                 writer,
                 503,
                 {"error": str(e)},
-                extra_headers={"Retry-After": f"{self.admission.retry_after_s:.0f}"},
+                extra_headers={
+                    "Retry-After": f"{self.admission.retry_after_s:.0f}",
+                    **rid_header,
+                },
             )
             return
 
@@ -450,7 +500,12 @@ class GenerateServer:
 
     async def _stream_response(self, reader, writer, ticket, events) -> None:
         writer.write(
-            _head(200, "OK", "text/event-stream", {"Cache-Control": "no-cache"})
+            _head(
+                200,
+                "OK",
+                "text/event-stream",
+                {"Cache-Control": "no-cache", "X-Request-Id": ticket.trace_id or ""},
+            )
         )
         await writer.drain()
         eof_watch = asyncio.ensure_future(reader.read(1))
@@ -467,12 +522,23 @@ class GenerateServer:
                 kind, a, b = getter.result()
                 if kind == "token":
                     event = {"uid": ticket.uid, "index": b, "token": a}
+                    # manual span, explicit parent: handlers interleave on one
+                    # thread, so the tracer's ambient (thread-local) nesting
+                    # would cross-wire concurrent streams
+                    flush = self.tracer.start_span(
+                        "sse_flush",
+                        trace_id=ticket.trace_id,
+                        parent=ticket.span,
+                        index=b,
+                    )
                     writer.write(_sse(event))
                     try:
                         await writer.drain()
                     except (ConnectionError, OSError):
+                        flush.set(outcome="disconnect").end()
                         self._client_gone(ticket)
                         return
+                    self.stats.observe("sse_flush_seconds", flush.end())
                 else:  # finish
                     writer.write(_sse(_completion_record(a)))
                     writer.write(b"data: [DONE]\n\n")
@@ -496,7 +562,12 @@ class GenerateServer:
                     return
                 kind, a, _b = getter.result()
                 if kind == "finish":
-                    await _respond_json(writer, 200, _completion_record(a))
+                    await _respond_json(
+                        writer,
+                        200,
+                        _completion_record(a),
+                        extra_headers={"X-Request-Id": ticket.trace_id or ""},
+                    )
                     return
         finally:
             if not eof_watch.done():

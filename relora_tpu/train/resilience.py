@@ -75,6 +75,15 @@ class PreemptionGuard:
             raise KeyboardInterrupt
         self.requested = True
         self.signum = signum
+        # the boundary may be seconds away (or never, if the step hangs) and
+        # the preemptor's grace window is short: dump the flight recorder NOW,
+        # from the handler, so the last spans survive even a hard kill
+        try:
+            from relora_tpu.obs import flight
+
+            flight.dump_on_fault("sigterm")
+        except Exception:
+            pass  # a failed dump must never break the signal handler
         logger.warning(
             f"received signal {signum}; requesting emergency checkpoint at the "
             "next update boundary (SIGINT again to abort immediately)"

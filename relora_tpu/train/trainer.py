@@ -44,6 +44,10 @@ from relora_tpu.core.relora import (
 from relora_tpu.core.schedules import make_schedule
 from relora_tpu.models.llama import LlamaForCausalLM
 from relora_tpu.models.params_util import init_params, logical_partition_specs
+from relora_tpu.obs import flight
+from relora_tpu.obs.metrics import MetricsRegistry
+from relora_tpu.obs.mfu import peak_flops, step_flops_from_cost_analysis
+from relora_tpu.obs.tracer import Tracer
 from relora_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
@@ -404,6 +408,27 @@ class Trainer:
             resume_id=self._wandb_id,
         )
         self._wandb_id = self.metrics.run_id
+        # span tracer for the update loop (data_fetch / dispatch / metric_pull
+        # / checkpoint / merge / reset); finished spans land in the flight
+        # recorder ring buffer for crash dumps, and optionally in a JSONL
+        # stream when RELORA_TPU_TRACE_DIR is set
+        trace_dir = os.environ.get("RELORA_TPU_TRACE_DIR")
+        self.tracer = Tracer(
+            service="train",
+            jsonl_path=os.path.join(trace_dir, "train_spans.jsonl") if trace_dir else None,
+        )
+        self.obs = MetricsRegistry(namespace="relora_train")
+        if cfg.save_dir:
+            flight.configure(dump_dir=cfg.save_dir)
+        # live MFU: measured step FLOPs (XLA cost_analysis, filled in lazily
+        # on the first batch) over the device's peak; 6ND analytic fallback
+        self._peak_flops = peak_flops()
+        self._n_params_6nd = (
+            model_cfg.num_params(include_embeddings=False)
+            + model_cfg.vocab_size * model_cfg.hidden_size
+        )
+        self._step_flops: Optional[float] = None
+        self._mfu_measured = False
         if cfg.save_dir and jax.process_index() == 0:
             os.makedirs(cfg.save_dir, exist_ok=True)
             cfg.save(os.path.join(cfg.save_dir, "training_config.yaml"))
@@ -511,6 +536,31 @@ class Trainer:
             yield out
 
     # ------------------------------------------------------------------
+    def _measure_step_flops(self, batch, rng) -> Optional[float]:
+        """Total FLOPs of one compiled train step, from XLA's cost model.
+
+        Runs once, lazily, on the first real batch (abstract lowering only —
+        no compile, no device work).  Returns None when the backend offers no
+        cost model or ``RELORA_TPU_LIVE_MFU=0``; the MFU gauge then falls
+        back to the 6ND analytic estimate (docs/observability.md)."""
+        if os.environ.get("RELORA_TPU_LIVE_MFU", "1") == "0":
+            return None
+        try:
+            def abs_of(x):
+                return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+            abs_args = jax.tree_util.tree_map(abs_of, (self.state, batch, rng))
+            with self.mesh:
+                lowered = self._train_step.lower(*abs_args)
+            flops = step_flops_from_cost_analysis(lowered.cost_analysis())
+        except Exception as e:  # backend-specific; never fail the run over MFU
+            logger.info(f"live MFU: cost_analysis unavailable ({e}); using 6ND estimate")
+            return None
+        if flops:
+            logger.info(f"live MFU: measured step cost {flops:.3e} FLOPs (cost_analysis)")
+        return flops
+
+    # ------------------------------------------------------------------
     def fit(
         self,
         train_iter: Iterator[np.ndarray],
@@ -573,7 +623,8 @@ class Trainer:
             nonlocal spike
             if not pending:
                 return True
-            records = _pull_metric_records([p[0] for p in pending])
+            with self.tracer.span("metric_pull", n_records=len(pending)):
+                records = _pull_metric_records([p[0] for p in pending])
             batch = [(m, *rest) for m, (_, *rest) in zip(records, pending)]
             pending.clear()
             for metrics, at_step, at_global, tokens_in_update, dt, counters in batch:
@@ -591,12 +642,22 @@ class Trainer:
                 loss_val = faults.perturb("loss", metrics["loss"], step=at_step)
                 if detector is not None and spike is None:
                     spike = detector.update(at_step, loss_val)
+                tokens_per_sec = tokens_in_update / dt
+                # live MFU: measured step FLOPs when the backend's cost model
+                # provided them, 6ND otherwise (same formula as bench MFU)
+                if self._step_flops:
+                    mfu = self._step_flops / dt / self._peak_flops
+                else:
+                    mfu = tokens_per_sec * 6 * self._n_params_6nd / self._peak_flops
+                self.obs.set_gauge("mfu", mfu)
+                self.obs.set_gauge("throughput_tokens_per_s", tokens_per_sec)
                 record = {
                     "loss": loss_val,
                     "lr": metrics.get("lr", 0.0),
                     "update_step": at_step,
                     "grad_norm": metrics["grad_norm"],
-                    "throughput_tokens": tokens_in_update / dt,
+                    "mfu": mfu,
+                    "throughput_tokens": tokens_per_sec,
                     "throughput_examples": cfg.total_batch_size / dt,
                     "throughput_batches": self.grad_accum * self.n_batch_shards / dt,
                     # snapshotted when the record was created, so counts
@@ -614,195 +675,233 @@ class Trainer:
             # already-finished run (e.g. autoresume past the budget): don't
             # pull/transfer any data
             train_iter = iter(())
-        with PreemptionGuard(enabled=cfg.handle_preemption) as guard:
-          # the while wrapper exists solely for spike rollback: a rollback
-          # rewinds counters and restarts the for loop on a rebuilt iterator
-          while True:
-            restart = False
-            exhausted = True
-            for batch in self._prefetched(train_iter):
-                if self.update_step >= cfg.num_training_steps:
-                    exhausted = False
-                    break
-                if self.update_step in cfg.skip_batches:
-                    # loss-spike blacklist, manual (torchrun_main.py:772-775)
-                    # or auto-extended by rollback: the batch is consumed
-                    # (data stream stays aligned) but its transfer is wasted
-                    # — acceptable for a rare blacklist
-                    self.metrics.event("batch_skipped", step=self.update_step)
-                    self.update_step += 1
-                    self.global_step += self.grad_accum
-                    continue
-
-                self.tokens_seen += int(batch.size)
-
-                self.state, metrics = self._train_step(
-                    self.state, batch, jax.random.fold_in(rng, self.update_step)
-                )
-                self.update_step += 1
-                self._local_updates += 1
-                self.global_step += self.grad_accum
-
-                # ---- graceful preemption --------------------------------
-                faults.tick("preempt", self.update_step)
-                if guard.requested:
-                    self.metrics.event(
-                        "preemption", step=self.update_step, signum=guard.signum
-                    )
-                    flush_pending()
-                    if cfg.save_dir:
-                        path = self.save(time.time() - update_start)
-                        if path:
-                            saved_at = self.update_step
-                            self.metrics.event(
-                                "emergency_checkpoint",
-                                step=self.update_step,
-                                path=path,
-                            )
-                    preempted = True
-                    exhausted = False
-                    break
-
-                # ---- save -----------------------------------------------
-                if (
-                    cfg.save_dir
-                    and cfg.save_every > 0
-                    and self._local_updates > 1
-                    and self.update_step % cfg.save_every == 0
-                ):
-                    if self.save(time.time() - update_start):
-                        saved_at = self.update_step
-
-                # ---- eval -----------------------------------------------
-                if (
-                    eval_iter_factory is not None
-                    and cfg.eval_every > 0
-                    and self.update_step % cfg.eval_every == 0
-                ):
-                    eval_loss, eval_tokens = self.evaluate(
-                        eval_iter_factory(), cfg.eval_tokens_during_training
-                    )
-                    self.metrics.log(
-                        {"final_eval_loss": eval_loss, "final_eval_tokens": eval_tokens},
-                        step=self.global_step,
-                    )
-                    logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
-
-                # ---- wandb.watch histograms (torchrun_main.py:624-627) --
-                if (
-                    self._watch_step is not None
-                    and cfg.eval_every > 0
-                    and self.update_step % cfg.eval_every == 0
-                ):
-                    hists = self._watch_step(
-                        self.state.params,
-                        batch[0],
-                        jax.random.fold_in(rng, 2**30 + self.update_step),
-                    )
-                    # one bulk transfer: per-element int()/float() on device
-                    # arrays would sync once per bin through the TPU tunnel
-                    self.metrics.log_histograms(
-                        jax.device_get(hists), step=self.global_step
-                    )
-
-                # ---- ReLoRA merge (torchrun_main.py:874-893) ------------
-                relora_every = cfg.relora  # 0 normalized to None in finalize
-                can_merge = relora_every is not None and (
-                    self._resumed or self._local_updates >= relora_every
-                )
-                if can_merge and (self.update_step - self.scheduler_start_step) % relora_every == 1:
-                    t0 = time.time()
-                    self.n_lora_restarts += 1
-                    self.state = self.state.replace(
-                        params=self._merge_fn(
-                            self.state.params,
-                            jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 2), self.update_step),
-                        )
-                    )
-                    jax.block_until_ready(self.state.params)
-                    logger.info(
-                        f"LoRA merge #{self.n_lora_restarts} at update {self.update_step} "
-                        f"took {time.time() - t0:.2f}s"
-                    )
-
-                # ---- optimizer reset (torchrun_main.py:895-912) ---------
-                cycle = cfg.cycle_length or cfg.relora
-                can_reset = cfg.relora is not None and cycle is not None and (
-                    self._resumed or self._local_updates >= cycle
-                )
-                if can_reset and (self.update_step - self.scheduler_start_step) % cycle == 1:
-                    self.n_optimizer_resets += 1
-                    reset_rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 3), self.update_step)
-                    self.state = self.state.replace(
-                        opt_state=self._reset_fn(self.state.opt_state, rng=reset_rng)
-                    )
-                    z = float(zeroed_fraction(self.state.opt_state))
-                    logger.info(
-                        f"Optimizer reset #{self.n_optimizer_resets} "
-                        f"({cfg.optimizer_reset_mode}) at update {self.update_step}: "
-                        f"{z*100:.2f}% of moments zero"
-                    )
-                    # post-reset LR sanity (training_utils.py:391-404)
-                    lr_now = float(self.schedule(jnp.asarray(self.update_step - self.scheduler_start_step)))
-                    if lr_now > self.cfg.lr:
-                        self.metrics.alert(
-                            "Learning rate issue",
-                            f"LR after reset is {lr_now} > max {self.cfg.lr}",
-                        )
-
-                # ---- metrics (torchrun_main.py:918-943), lagged ---------
-                # flush BEFORE appending: with log_every=1 this is exactly
-                # the historical one-step lag; larger values batch up to
-                # log_every records into one device pull
-                if len(pending) >= cfg.log_every and not flush_pending():
-                    exhausted = False
-                    aborted = True
-                    break
-                update_time = time.time() - update_start
-                update_start = time.time()
-                tokens_in_update = self.tokens_seen - self.tokens_seen_before
-                self.tokens_seen_before = self.tokens_seen
-                pending.append(
-                    (
-                        metrics,
-                        self.update_step,
-                        self.global_step,
-                        tokens_in_update,
-                        update_time,
-                        {
-                            "tokens_seen": self.tokens_seen,
-                            "n_lora_restarts": self.n_lora_restarts,
-                            "n_optimizer_resets": self.n_optimizer_resets,
-                        },
-                    )
-                )
-                if prof is not None:
-                    # per update step, regardless of the flush cadence
-                    prof.step()
-
-                # ---- loss-spike rollback --------------------------------
-                if spike is not None:
-                    ev, spike = spike, None
-                    rolled_back = self._handle_spike(
-                        ev, can_realign=train_iter_factory is not None
-                    )
-                    detector.reset_streak()
-                    if rolled_back:
-                        # drop the lagged metric records — the steps they
-                        # describe were just undone
-                        pending.clear()
-                        restart = True
+        try:
+            with PreemptionGuard(enabled=cfg.handle_preemption) as guard:
+              # the while wrapper exists solely for spike rollback: a rollback
+              # rewinds counters and restarts the for loop on a rebuilt iterator
+              while True:
+                restart = False
+                exhausted = True
+                batches = self._prefetched(train_iter)
+                while True:
+                  # one "update_step" span per iteration; the explicit next() puts
+                  # the data wait inside it as a "data_fetch" child (a for-loop
+                  # fetches in the header, outside any span).  Two-space nesting
+                  # keeps the loop body's indentation unchanged.
+                  with self.tracer.span("update_step", step=self.update_step):
+                    with self.tracer.span("data_fetch"):
+                        batch = next(batches, None)
+                    if batch is None:
+                        break  # data ran out; exhausted stays True (for-else parity)
+                    if self.update_step >= cfg.num_training_steps:
                         exhausted = False
                         break
-            if restart:
-                train_iter = train_iter_factory()
-                update_start = time.time()
-                continue
-            break
+                    if self.update_step in cfg.skip_batches:
+                        # loss-spike blacklist, manual (torchrun_main.py:772-775)
+                        # or auto-extended by rollback: the batch is consumed
+                        # (data stream stays aligned) but its transfer is wasted
+                        # — acceptable for a rare blacklist
+                        self.metrics.event("batch_skipped", step=self.update_step)
+                        self.update_step += 1
+                        self.global_step += self.grad_accum
+                        continue
+
+                    self.tokens_seen += int(batch.size)
+
+                    if not self._mfu_measured:
+                        # first real batch: ask XLA's cost model what one step
+                        # costs, so the MFU gauge uses measured FLOPs not 6ND
+                        self._mfu_measured = True
+                        self._step_flops = self._measure_step_flops(
+                            batch, jax.random.fold_in(rng, self.update_step)
+                        )
+                    with self.tracer.span("dispatch", step=self.update_step):
+                        # async dispatch: this span is enqueue cost, not device
+                        # step time — the blocking pull happens in metric_pull
+                        self.state, metrics = self._train_step(
+                            self.state, batch, jax.random.fold_in(rng, self.update_step)
+                        )
+                    self.update_step += 1
+                    self._local_updates += 1
+                    self.global_step += self.grad_accum
+
+                    # ---- graceful preemption --------------------------------
+                    faults.tick("preempt", self.update_step)
+                    if guard.requested:
+                        self.metrics.event(
+                            "preemption", step=self.update_step, signum=guard.signum
+                        )
+                        flush_pending()
+                        if cfg.save_dir:
+                            path = self.save(time.time() - update_start)
+                            if path:
+                                saved_at = self.update_step
+                                self.metrics.event(
+                                    "emergency_checkpoint",
+                                    step=self.update_step,
+                                    path=path,
+                                )
+                        preempted = True
+                        exhausted = False
+                        break
+
+                    # ---- save -----------------------------------------------
+                    if (
+                        cfg.save_dir
+                        and cfg.save_every > 0
+                        and self._local_updates > 1
+                        and self.update_step % cfg.save_every == 0
+                    ):
+                        if self.save(time.time() - update_start):
+                            saved_at = self.update_step
+
+                    # ---- eval -----------------------------------------------
+                    if (
+                        eval_iter_factory is not None
+                        and cfg.eval_every > 0
+                        and self.update_step % cfg.eval_every == 0
+                    ):
+                        with self.tracer.span("eval", step=self.update_step):
+                            eval_loss, eval_tokens = self.evaluate(
+                                eval_iter_factory(), cfg.eval_tokens_during_training
+                            )
+                        self.metrics.log(
+                            {"final_eval_loss": eval_loss, "final_eval_tokens": eval_tokens},
+                            step=self.global_step,
+                        )
+                        logger.info(f"Eval loss at step {self.update_step}: {eval_loss:.4f}")
+
+                    # ---- wandb.watch histograms (torchrun_main.py:624-627) --
+                    if (
+                        self._watch_step is not None
+                        and cfg.eval_every > 0
+                        and self.update_step % cfg.eval_every == 0
+                    ):
+                        with self.tracer.span("watch_histograms", step=self.update_step):
+                            hists = self._watch_step(
+                                self.state.params,
+                                batch[0],
+                                jax.random.fold_in(rng, 2**30 + self.update_step),
+                            )
+                            # one bulk transfer: per-element int()/float() on device
+                            # arrays would sync once per bin through the TPU tunnel
+                            self.metrics.log_histograms(
+                                jax.device_get(hists), step=self.global_step
+                            )
+
+                    # ---- ReLoRA merge (torchrun_main.py:874-893) ------------
+                    relora_every = cfg.relora  # 0 normalized to None in finalize
+                    can_merge = relora_every is not None and (
+                        self._resumed or self._local_updates >= relora_every
+                    )
+                    if can_merge and (self.update_step - self.scheduler_start_step) % relora_every == 1:
+                        t0 = time.time()
+                        self.n_lora_restarts += 1
+                        with self.tracer.span(
+                            "relora_merge", step=self.update_step, n=self.n_lora_restarts
+                        ):
+                            self.state = self.state.replace(
+                                params=self._merge_fn(
+                                    self.state.params,
+                                    jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 2), self.update_step),
+                                )
+                            )
+                            jax.block_until_ready(self.state.params)
+                        logger.info(
+                            f"LoRA merge #{self.n_lora_restarts} at update {self.update_step} "
+                            f"took {time.time() - t0:.2f}s"
+                        )
+
+                    # ---- optimizer reset (torchrun_main.py:895-912) ---------
+                    cycle = cfg.cycle_length or cfg.relora
+                    can_reset = cfg.relora is not None and cycle is not None and (
+                        self._resumed or self._local_updates >= cycle
+                    )
+                    if can_reset and (self.update_step - self.scheduler_start_step) % cycle == 1:
+                        self.n_optimizer_resets += 1
+                        reset_rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 3), self.update_step)
+                        with self.tracer.span(
+                            "optimizer_reset", step=self.update_step, n=self.n_optimizer_resets
+                        ):
+                            self.state = self.state.replace(
+                                opt_state=self._reset_fn(self.state.opt_state, rng=reset_rng)
+                            )
+                            z = float(zeroed_fraction(self.state.opt_state))
+                        logger.info(
+                            f"Optimizer reset #{self.n_optimizer_resets} "
+                            f"({cfg.optimizer_reset_mode}) at update {self.update_step}: "
+                            f"{z*100:.2f}% of moments zero"
+                        )
+                        # post-reset LR sanity (training_utils.py:391-404)
+                        lr_now = float(self.schedule(jnp.asarray(self.update_step - self.scheduler_start_step)))
+                        if lr_now > self.cfg.lr:
+                            self.metrics.alert(
+                                "Learning rate issue",
+                                f"LR after reset is {lr_now} > max {self.cfg.lr}",
+                            )
+
+                    # ---- metrics (torchrun_main.py:918-943), lagged ---------
+                    # flush BEFORE appending: with log_every=1 this is exactly
+                    # the historical one-step lag; larger values batch up to
+                    # log_every records into one device pull
+                    if len(pending) >= cfg.log_every and not flush_pending():
+                        exhausted = False
+                        aborted = True
+                        break
+                    update_time = time.time() - update_start
+                    update_start = time.time()
+                    tokens_in_update = self.tokens_seen - self.tokens_seen_before
+                    self.tokens_seen_before = self.tokens_seen
+                    pending.append(
+                        (
+                            metrics,
+                            self.update_step,
+                            self.global_step,
+                            tokens_in_update,
+                            update_time,
+                            {
+                                "tokens_seen": self.tokens_seen,
+                                "n_lora_restarts": self.n_lora_restarts,
+                                "n_optimizer_resets": self.n_optimizer_resets,
+                            },
+                        )
+                    )
+                    if prof is not None:
+                        # per update step, regardless of the flush cadence
+                        prof.step()
+
+                    # ---- loss-spike rollback --------------------------------
+                    if spike is not None:
+                        ev, spike = spike, None
+                        rolled_back = self._handle_spike(
+                            ev, can_realign=train_iter_factory is not None
+                        )
+                        detector.reset_streak()
+                        if rolled_back:
+                            # drop the lagged metric records — the steps they
+                            # describe were just undone
+                            pending.clear()
+                            restart = True
+                            exhausted = False
+                            break
+                if restart:
+                    train_iter = train_iter_factory()
+                    update_start = time.time()
+                    continue
+                break
+        except BaseException:
+            # any crash inside the update loop leaves a flight dump
+            # behind: the last ~2k spans/events, rendered by
+            # tools/trace_report.py (docs/observability.md)
+            flight.dump_on_fault("crash")
+            raise
+        finally:
+            if prof is not None:
+                # close(), not stop(): a mid-window exit must not leak
+                # the process-global jax.profiler trace
+                prof.close()
         if not flush_pending():
             aborted = True
-        if prof is not None:
-            prof.stop()
         if exhausted and self.update_step < cfg.num_training_steps:
             # for-else equivalent (torchrun_main.py:945-947)
             logger.warning("Reached the end of the dataset before num_training_steps")
@@ -828,6 +927,7 @@ class Trainer:
             )
             result["final_eval_loss"] = final_loss
         self.metrics.finish()
+        self.tracer.close()  # flush + release the JSONL sink, if configured
         # fence pending async checkpoint writes before declaring the run done
         # (process exit must not truncate an in-flight save)
         ckpt.wait_for_save()
@@ -929,6 +1029,9 @@ class Trainer:
             f"(loss={spike.loss:.4f}, baseline median={spike.median:.4f}, "
             f"mad={spike.mad:.4f})"
         )
+        # forensics before any rollback mutates state: what was the loop
+        # doing in the steps leading up to the spike?
+        flight.dump_on_fault("loss_spike")
         reason = None
         if self.n_spike_rollbacks >= cfg.max_spike_rollbacks:
             reason = f"rollback budget exhausted ({cfg.max_spike_rollbacks})"
@@ -1003,15 +1106,16 @@ class Trainer:
             "n_spike_rollbacks": self.n_spike_rollbacks,
         }
         try:
-            path = ckpt.save_checkpoint(
-                self.cfg.save_dir,
-                self.update_step,
-                self.state,
-                training_state,
-                self.lora_spec,
-                retries=self.cfg.save_retries,
-                retry_backoff=self.cfg.save_retry_backoff,
-            )
+            with self.tracer.span("checkpoint", step=self.update_step):
+                path = ckpt.save_checkpoint(
+                    self.cfg.save_dir,
+                    self.update_step,
+                    self.state,
+                    training_state,
+                    self.lora_spec,
+                    retries=self.cfg.save_retries,
+                    retry_backoff=self.cfg.save_retry_backoff,
+                )
         except (OSError, ValueError) as e:
             # a lost periodic checkpoint must not kill a long run: the
             # previous committed checkpoint stays the resume target and the

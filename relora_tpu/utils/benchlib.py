@@ -11,8 +11,12 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-# bf16 peak of one TPU v5e (v5 lite) chip
-PEAK_FLOPS_V5E = 197e12
+from relora_tpu.obs.mfu import PEAK_FLOPS_DEFAULT
+from relora_tpu.obs.mfu import peak_flops as detect_peak_flops
+
+# kept for importers; the actual per-device table (and the
+# RELORA_TPU_PEAK_FLOPS override) lives in relora_tpu.obs.mfu
+PEAK_FLOPS_V5E = PEAK_FLOPS_DEFAULT
 
 
 def run_throughput_bench(
@@ -34,7 +38,7 @@ def run_throughput_bench(
     warmup_steps: int = 3,
     measure_steps: int = 10,
     magnitude_reset: bool = False,
-    peak_flops: float = PEAK_FLOPS_V5E,
+    peak_flops: Optional[float] = None,
 ) -> dict:
     """Build the ReLoRA train step for ``model_name`` and measure steady-state
     training throughput on the default backend.  Returns a dict with
@@ -133,10 +137,14 @@ def run_throughput_bench(
         hbm_peak_gb = None
     # 6*N per token fwd+bwd on the dense (equivalent) params
     n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
+    if peak_flops is None:
+        # per-device table keyed on device_kind; RELORA_TPU_PEAK_FLOPS overrides
+        peak_flops = detect_peak_flops(jax.devices()[0])
     mfu = tokens_per_sec * 6 * n_params / peak_flops
     return {
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4),
+        "peak_flops": peak_flops,
         "step_time_s": round(dt / measure_steps, 4),
         "tokens_per_update": tokens_per_update,
         "warmup_steps_effective": warmup_steps_effective,

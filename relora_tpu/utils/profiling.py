@@ -67,6 +67,19 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._tracing = False
 
+    # an exit mid-window (exception, preemption, budget hit) used to leak the
+    # active jax.profiler trace — a global: the next start_trace anywhere in
+    # the process would raise.  close() is the idempotent shutdown hook; the
+    # trainer calls it from a finally, and `with StepProfiler(...)` works too.
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self) -> "StepProfiler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
 
 def maybe_make_profiler(cfg, run_name: str = "run") -> Optional[StepProfiler]:
     """None unless --profile true (parity: torchrun_main.py:322-335)."""

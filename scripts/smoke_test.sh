@@ -152,4 +152,18 @@ EOF
 kill -TERM "$SERVER_PID"
 wait "$SERVER_PID"   # exit 0 = SIGTERM drain completed cleanly
 
+echo "=== 10. traced run + SIGTERM flight dump (obs subsystem) ==="
+# fault injection fires a real SIGTERM at update 4; the PreemptionGuard
+# handler dumps the span flight recorder before the emergency checkpoint
+RELORA_TPU_TRACE_DIR="$WORK/traces" RELORA_TPU_FAULTS="preempt:at=4" \
+python main.py "${common[@]}" --lr 3e-3 --scheduler cosine --cycle_length 8 \
+    --num_training_steps 16 --save_every 100 --save_dir "$WORK/traced"
+ls "$WORK"/traced/flight_sigterm_*.json >/dev/null
+# the report must parse the dump and see the trainer's span structure
+python tools/trace_report.py "$WORK"/traced/flight_sigterm_*.json | tee "$WORK/trace_report.txt" | head -12
+grep -q "update_step" "$WORK/trace_report.txt"
+grep -q "dispatch" "$WORK/trace_report.txt"
+# the JSONL sink recorded the same spans and renders too
+python tools/trace_report.py "$WORK/traces/train_spans.jsonl" --max-traces 1 | grep -q "update_step"
+
 echo "SMOKE OK"

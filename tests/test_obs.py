@@ -1,0 +1,390 @@
+"""Observability subsystem: span tracer, metrics registry, flight recorder,
+MFU helpers, and the trace report tool.
+
+The load-bearing test here is the golden /metrics render: ServeMetrics was
+extracted into the shared ``relora_tpu.obs.metrics.MetricsRegistry``, and
+the acceptance criterion is that the ``/metrics`` body is **byte-identical**
+to the pre-refactor renderer.  The golden string below was captured from the
+pre-extraction ``serve/admission.ServeMetrics`` — do not regenerate it from
+the current code; that would defeat the pin.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from relora_tpu.obs.flight import FlightRecorder, dump_on_fault
+from relora_tpu.obs.metrics import LATENCY_BUCKETS, Histogram, MetricsRegistry
+from relora_tpu.obs.mfu import (
+    PEAK_FLOPS_DEFAULT,
+    peak_flops,
+    step_flops_from_cost_analysis,
+)
+from relora_tpu.obs.tracer import NoopTracer, Tracer, chrome_trace_events, new_trace_id
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry: golden render (byte-identical to pre-refactor ServeMetrics)
+
+GOLDEN_RENDER = (
+    '# TYPE relora_serve_http_requests_total counter\n'
+    'relora_serve_http_requests_total{route="generate"} 2\n'
+    'relora_serve_http_requests_total{route="healthz"} 1\n'
+    '# TYPE relora_serve_rejected_total counter\n'
+    'relora_serve_rejected_total{reason="queue_full"} 1\n'
+    '# TYPE relora_serve_requests_finished_total counter\n'
+    'relora_serve_requests_finished_total{reason="length"} 2\n'
+    '# TYPE relora_serve_tokens_generated_total counter\n'
+    'relora_serve_tokens_generated_total 7\n'
+    '# TYPE relora_serve_active_slots gauge\n'
+    'relora_serve_active_slots 2\n'
+    '# TYPE relora_serve_draining gauge\n'
+    'relora_serve_draining 0\n'
+    '# TYPE relora_serve_queue_depth gauge\n'
+    'relora_serve_queue_depth 3\n'
+    '# TYPE relora_serve_tpot_seconds histogram\n'
+    'relora_serve_tpot_seconds_bucket{le="0.001"} 0\n'
+    'relora_serve_tpot_seconds_bucket{le="0.0025"} 0\n'
+    'relora_serve_tpot_seconds_bucket{le="0.005"} 0\n'
+    'relora_serve_tpot_seconds_bucket{le="0.01"} 0\n'
+    'relora_serve_tpot_seconds_bucket{le="0.025"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="0.05"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="0.1"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="0.25"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="0.5"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="1"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="2.5"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="5"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="10"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="30"} 1\n'
+    'relora_serve_tpot_seconds_bucket{le="+Inf"} 1\n'
+    'relora_serve_tpot_seconds_sum 0.020000\n'
+    'relora_serve_tpot_seconds_count 1\n'
+    '# TYPE relora_serve_ttft_seconds histogram\n'
+    'relora_serve_ttft_seconds_bucket{le="0.001"} 0\n'
+    'relora_serve_ttft_seconds_bucket{le="0.0025"} 0\n'
+    'relora_serve_ttft_seconds_bucket{le="0.005"} 1\n'
+    'relora_serve_ttft_seconds_bucket{le="0.01"} 1\n'
+    'relora_serve_ttft_seconds_bucket{le="0.025"} 2\n'
+    'relora_serve_ttft_seconds_bucket{le="0.05"} 2\n'
+    'relora_serve_ttft_seconds_bucket{le="0.1"} 2\n'
+    'relora_serve_ttft_seconds_bucket{le="0.25"} 2\n'
+    'relora_serve_ttft_seconds_bucket{le="0.5"} 3\n'
+    'relora_serve_ttft_seconds_bucket{le="1"} 3\n'
+    'relora_serve_ttft_seconds_bucket{le="2.5"} 4\n'
+    'relora_serve_ttft_seconds_bucket{le="5"} 4\n'
+    'relora_serve_ttft_seconds_bucket{le="10"} 4\n'
+    'relora_serve_ttft_seconds_bucket{le="30"} 4\n'
+    'relora_serve_ttft_seconds_bucket{le="+Inf"} 5\n'
+    'relora_serve_ttft_seconds_sum 33.321000\n'
+    'relora_serve_ttft_seconds_count 5\n'
+)
+
+
+def _populated_serve_metrics():
+    # deferred import: pulls in the serve stack (jax) only for the tests
+    # that pin the ServeMetrics subclass specifically
+    from relora_tpu.serve.admission import ServeMetrics
+
+    m = ServeMetrics()
+    m.inc("http_requests_total", ("route", "generate"))
+    m.inc("http_requests_total", ("route", "generate"))
+    m.inc("http_requests_total", ("route", "healthz"))
+    m.inc("tokens_generated_total", by=7)
+    m.inc("rejected_total", ("reason", "queue_full"))
+    m.inc("requests_finished_total", ("reason", "length"), by=2)
+    m.set_gauge("draining", 0)
+    m.set_gauge("queue_depth", 3)
+    m.set_gauge("active_slots", 2.0)
+    for v in (0.004, 0.017, 0.3, 2.0, 31.0):
+        m.observe("ttft_seconds", v)
+    m.observe("tpot_seconds", 0.02)
+    return m
+
+
+def test_serve_metrics_render_byte_identical_golden():
+    assert _populated_serve_metrics().render() == GOLDEN_RENDER
+
+
+def test_serve_metrics_snapshot_golden():
+    assert _populated_serve_metrics().snapshot() == {
+        "http_requests_total.generate": 2,
+        "http_requests_total.healthz": 1,
+        "rejected_total.queue_full": 1,
+        "requests_finished_total.length": 2,
+        "tokens_generated_total": 7,
+        "draining": 0,
+        "queue_depth": 3,
+        "active_slots": 2.0,
+        "ttft_seconds_count": 5,
+        "ttft_seconds_sum": 33.321,
+        "tpot_seconds_count": 1,
+        "tpot_seconds_sum": 0.02,
+    }
+
+
+def test_registry_namespace_and_accessors():
+    r = MetricsRegistry(namespace="relora_train")
+    r.set_gauge("mfu", 0.42)
+    r.inc("steps_total")
+    r.observe("metric_pull_seconds", 0.003)
+    assert "relora_train_mfu 0.42" in r.render()
+    assert r.gauge_value("mfu") == 0.42
+    assert r.counter_value("steps_total") == 1
+    assert r.histogram("metric_pull_seconds").count == 1
+    assert r.histogram("missing") is None
+
+
+def test_histogram_quantile():
+    h = Histogram()
+    for v in (0.004, 0.004, 0.004, 0.09, 2.0):
+        h.observe(v)
+    # p50 of 5 samples lands in the 0.005 bucket; p95 in the 2.5 bucket
+    assert h.quantile(0.5) == 0.005
+    assert h.quantile(0.95) == 2.5
+    assert Histogram().quantile(0.5) == 0.0
+    h2 = Histogram()
+    h2.observe(100.0)  # beyond the last bound -> +Inf bucket
+    assert h2.quantile(0.5) == float("inf")
+    assert h.bounds == LATENCY_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# tracer
+
+
+def test_span_nesting_builds_a_tree():
+    rec = FlightRecorder()
+    tr = Tracer(service="t", recorder=rec)
+    with tr.span("root", kind="test") as root:
+        with tr.span("child_a"):
+            with tr.span("grandchild"):
+                pass
+        with tr.span("child_b"):
+            pass
+    spans = {s["name"]: s for s in rec.spans()}
+    assert set(spans) == {"root", "child_a", "grandchild", "child_b"}
+    assert spans["root"]["parent_id"] is None
+    assert spans["child_a"]["parent_id"] == spans["root"]["span_id"]
+    assert spans["child_b"]["parent_id"] == spans["root"]["span_id"]
+    assert spans["grandchild"]["parent_id"] == spans["child_a"]["span_id"]
+    # one trace id for the whole tree; attrs and durations recorded
+    assert len({s["trace_id"] for s in spans.values()}) == 1
+    assert spans["root"]["attrs"] == {"kind": "test"}
+    assert all(s["dur_s"] >= 0 for s in spans.values())
+    assert root.t_end is not None
+    assert tr.current_span() is None  # stack fully unwound
+
+
+def test_span_end_is_idempotent_and_set_chains():
+    rec = FlightRecorder()
+    tr = Tracer(service="t", recorder=rec)
+    sp = tr.start_span("manual", uid=1)
+    d1 = sp.set(outcome="ok").end()
+    d2 = sp.end()
+    assert d1 == d2
+    assert len(rec.spans()) == 1  # recorded exactly once
+    assert rec.spans()[0]["attrs"] == {"uid": 1, "outcome": "ok"}
+
+
+def test_cross_thread_span_with_explicit_parent():
+    """The serving pattern: a root span starts on one thread, children are
+    attached from another thread via explicit parent= (never the ambient
+    stack, which is thread-local)."""
+    rec = FlightRecorder()
+    tr = Tracer(service="t", recorder=rec)
+    rid = new_trace_id()
+    root = tr.start_span("request", trace_id=rid, uid=7)
+
+    def worker():
+        child = tr.start_span("phase", trace_id=rid, parent=root)
+        child.end()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    root.end()
+    spans = {s["name"]: s for s in rec.spans()}
+    assert spans["phase"]["parent_id"] == spans["request"]["span_id"]
+    assert spans["phase"]["trace_id"] == rid == spans["request"]["trace_id"]
+    assert spans["phase"]["thread"] != spans["request"]["thread"]
+
+
+def test_exception_inside_span_still_records_and_unwinds():
+    rec = FlightRecorder()
+    tr = Tracer(service="t", recorder=rec)
+    with pytest.raises(ValueError):
+        with tr.span("outer"):
+            with tr.span("inner"):
+                raise ValueError("boom")
+    assert {s["name"] for s in rec.spans()} == {"outer", "inner"}
+    assert tr.current_span() is None
+
+
+def test_tracer_jsonl_sink(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tr = Tracer(service="t", recorder=FlightRecorder(), jsonl_path=str(path))
+    with tr.span("a"):
+        pass
+    tr.event("tick")  # events do not go to the JSONL sink, only spans
+    with tr.span("b"):
+        pass
+    tr.close()
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [rec["name"] for rec in lines] == ["a", "b"]
+    with tr.span("after_close"):  # close() drops the sink, not the tracer
+        pass
+    assert len(path.read_text().splitlines()) == 2
+
+
+def test_noop_tracer_is_api_compatible():
+    tr = NoopTracer()
+    with tr.span("x", attr=1) as sp:
+        assert sp.end() == 0.0
+        assert sp.set(foo="bar") is sp
+    sp = tr.start_span("y")
+    sp.end()
+    tr.event("e")
+    tr.close()
+    assert tr.current_span() is None
+    assert tr.enabled is False
+
+
+def test_chrome_trace_export():
+    rec = FlightRecorder()
+    tr = Tracer(service="svc", recorder=rec)
+    with tr.span("step", n=3):
+        time.sleep(0.001)
+    tr.event("marker", note="hi")
+    events = chrome_trace_events(rec.spans(), rec.events(), pid=42)
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    (x,) = by_ph["X"]
+    assert x["name"] == "step" and x["cat"] == "svc" and x["pid"] == 42
+    assert x["dur"] >= 1000  # microseconds
+    assert x["args"]["n"] == 3
+    (i,) = by_ph["i"]
+    assert i["name"] == "marker" and i["args"]["note"] == "hi"
+    assert by_ph["M"][0]["args"]["name"]  # thread_name metadata present
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_ring_buffer_bounds_and_dump(tmp_path):
+    rec = FlightRecorder(span_capacity=4, event_capacity=2)
+    for i in range(7):
+        rec.add_span({"name": f"s{i}", "trace_id": "t", "span_id": str(i)})
+    rec.add_event({"name": "e"})
+    assert [s["name"] for s in rec.spans()] == ["s3", "s4", "s5", "s6"]
+    assert rec.dropped_spans == 3
+    path = rec.dump(str(tmp_path / "d" / "flight.json"), reason="drill")
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "drill"
+    assert payload["pid"] == os.getpid()
+    assert payload["dropped_spans"] == 3
+    assert len(payload["spans"]) == 4 and len(payload["events"]) == 1
+    rec.clear()
+    assert rec.spans() == [] and rec.dropped_spans == 0
+
+
+def test_dump_on_fault_env_dir_and_empty_buffer(tmp_path, monkeypatch):
+    from relora_tpu.obs import flight
+
+    monkeypatch.setenv("RELORA_TPU_FLIGHT_DIR", str(tmp_path))
+    flight.default_recorder().clear()
+    assert dump_on_fault("nothing_recorded") is None  # empty buffer -> no file
+    Tracer(service="t").start_span("s").end()  # default recorder
+    path = dump_on_fault("drill")
+    assert path == str(tmp_path / f"flight_drill_{os.getpid()}.json")
+    assert json.loads(open(path).read())["reason"] == "drill"
+    flight.default_recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# MFU helpers
+
+
+class _FakeDevice:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+def test_peak_flops_table_and_env_override(monkeypatch):
+    monkeypatch.delenv("RELORA_TPU_PEAK_FLOPS", raising=False)
+    assert peak_flops(_FakeDevice("TPU v5e")) == 197e12
+    assert peak_flops(_FakeDevice("TPU v5p chip")) == 459e12
+    assert peak_flops(_FakeDevice("TPU v6e")) == 918e12
+    assert peak_flops(_FakeDevice("TPU v4")) == 275e12
+    assert peak_flops(_FakeDevice("NVIDIA H100 80GB")) == 989e12
+    assert peak_flops(_FakeDevice("cpu")) == PEAK_FLOPS_DEFAULT
+    monkeypatch.setenv("RELORA_TPU_PEAK_FLOPS", "123e12")
+    assert peak_flops(_FakeDevice("TPU v5e")) == 123e12  # override wins
+
+
+def test_step_flops_from_cost_analysis_shapes():
+    assert step_flops_from_cost_analysis({"flops": 5.0}) == 5.0
+    assert step_flops_from_cost_analysis([{"flops": 2.0}, {"flops": 3.0}]) == 5.0
+    assert step_flops_from_cost_analysis(None) is None
+    assert step_flops_from_cost_analysis({}) is None
+    assert step_flops_from_cost_analysis([{"flops": 0.0}]) is None
+    assert step_flops_from_cost_analysis([{"bytes": 1}, "junk"]) is None
+
+
+def test_benchlib_peak_flops_alias():
+    # importers of the old constant keep working, and it matches the table
+    from relora_tpu.utils.benchlib import PEAK_FLOPS_V5E
+
+    assert PEAK_FLOPS_V5E == PEAK_FLOPS_DEFAULT == 197e12
+
+
+# ---------------------------------------------------------------------------
+# trace report tool
+
+
+def test_trace_report_renders_dump_and_chrome_export(tmp_path):
+    rec = FlightRecorder()
+    tr = Tracer(service="train", recorder=rec)
+    for step in range(2):
+        with tr.span("update_step", step=step):
+            with tr.span("data_fetch"):
+                pass
+            with tr.span("dispatch", step=step):
+                time.sleep(0.002)
+    dump = rec.dump(str(tmp_path / "flight_manual_1.json"), reason="manual")
+    chrome = tmp_path / "chrome.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"), dump,
+         "--chrome", str(chrome)],
+        capture_output=True, text=True, check=True, cwd=str(tmp_path),
+    ).stdout
+    assert "reason=manual" in out
+    assert "update_step" in out and "dispatch" in out and "data_fetch" in out
+    assert "p50_ms" in out and "p95_ms" in out
+    events = json.loads(chrome.read_text())["traceEvents"]
+    assert any(e["ph"] == "X" and e["name"] == "dispatch" for e in events)
+
+
+def test_trace_report_reads_jsonl_stream(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tr = Tracer(service="t", recorder=FlightRecorder(), jsonl_path=str(path))
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    tr.close()
+    with open(path, "a") as fh:
+        fh.write('{"torn line')  # killed writer leaves a torn tail: tolerated
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"), str(path)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "outer" in out and "inner" in out

@@ -88,3 +88,21 @@ def test_disabled_profiler_never_touches_jax(events, tmp_path):
     # mean zero profiler calls; nothing must have been recorded
     assert maybe_make_profiler(type("C", (), {"profile": False})()) is None
     assert events == []
+
+
+def test_close_and_context_manager_end_open_window(events, tmp_path):
+    # close() is the trainer's finally-path alias for stop(): a crash or
+    # preemption mid-window must not leak the process-global jax trace
+    prof = StepProfiler(str(tmp_path), wait=0, warmup=0, active=5, repeat=1)
+    prof.step()
+    prof.close()
+    assert kinds(events) == ["start", "stop"]
+    prof.close()  # idempotent
+    assert kinds(events) == ["start", "stop"]
+
+    events.clear()
+    with pytest.raises(RuntimeError):
+        with StepProfiler(str(tmp_path), wait=0, warmup=0, active=5, repeat=1) as p:
+            p.step()
+            raise RuntimeError("aborted mid-window")
+    assert kinds(events) == ["start", "stop"]

@@ -413,3 +413,64 @@ def test_resume_with_changed_batch_size_rejected(tmp_path):
     cfg2 = make_cfg(tmp_path, num_training_steps=16, batch_size=2, autoresume=True)
     with pytest.raises(RuntimeError, match="batch size"):
         Trainer(cfg2, model_cfg=TINY)
+
+
+@pytest.mark.faults
+def test_sigterm_flight_dump_and_span_tree(tmp_path, monkeypatch):
+    """The crash flight recorder drill: a real SIGTERM mid-loop makes the
+    PreemptionGuard handler dump the span ring buffer, and the dump holds a
+    complete, well-nested trace of the update loop that trace_report can
+    render."""
+    import glob
+    import subprocess
+    import sys
+
+    from relora_tpu.obs import flight
+    from relora_tpu.train.trainer import Trainer
+
+    monkeypatch.setenv("RELORA_TPU_FLIGHT_DIR", str(tmp_path))
+    # the recorder is process-wide: start the drill from a clean buffer so
+    # spans from earlier tests in this process can't leak into the dump
+    flight.default_recorder().clear()
+
+    data = FakeTokens(n=512)
+    cfg = make_cfg(tmp_path, num_training_steps=16, save_every=100)
+    trainer = Trainer(cfg, model_cfg=TINY)
+    faults.configure("preempt", at=4)
+    res = trainer.fit(make_train_factory(cfg, trainer, data)(), None)
+    assert res["preempted"] is True
+
+    dumps = glob.glob(str(tmp_path / f"flight_sigterm_{os.getpid()}.json"))
+    assert len(dumps) == 1, dumps
+    with open(dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "sigterm"
+    assert payload["pid"] == os.getpid()
+
+    spans = payload["spans"]
+    train_spans = [s for s in spans if s["service"] == "train"]
+    assert train_spans, "no trainer spans in the dump"
+    # one training run = one trace id across every span
+    assert len({s["trace_id"] for s in train_spans}) == 1
+    steps = [s for s in train_spans if s["name"] == "update_step"]
+    assert len(steps) >= 3  # preempted at update 4
+    by_parent = {}
+    for s in train_spans:
+        by_parent.setdefault(s["parent_id"], []).append(s["name"])
+    # every completed update_step parents its phases
+    last = steps[-1]
+    assert {"data_fetch", "dispatch"} <= set(by_parent[last["span_id"]])
+    assert any("metric_pull" in kids for kids in by_parent.values())
+
+    # the report tool renders the dump end to end
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                      "tools", "trace_report.py"),
+         dumps[0]],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "reason=sigterm" in out
+    assert "update_step" in out and "dispatch" in out
+
+    flight.default_recorder().clear()

@@ -435,3 +435,71 @@ def test_drain_finishes_in_flight_and_rejects_new(engine):
         assert server.drained.wait(60), "model thread did not exit after drain"
         holder.thread.join(60)
         assert not holder.thread.is_alive(), "serve_forever did not return"
+
+
+def test_request_id_header_and_span_propagation(engine):
+    """One request id threads the whole stack: the client's X-Request-Id
+    becomes the span trace_id on every phase (request, queue_wait, prefill,
+    insert, decode, sse_flush) and is echoed on the response; without the
+    header the server mints one."""
+    from relora_tpu.obs.flight import FlightRecorder
+    from relora_tpu.obs.tracer import Tracer
+
+    recorder = FlightRecorder()
+    tracer = Tracer(service="serve", recorder=recorder)
+    with _Server(engine, tracer=tracer) as server:
+        port = server.port
+        rid = "feedfacecafebeef"
+        head = (
+            "POST /v1/generate HTTP/1.1\r\nHost: test\r\n"
+            f"X-Request-Id: {rid}\r\n"
+        )
+        payload = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4}).encode()
+        with socket.create_connection(("127.0.0.1", port), timeout=60) as sock:
+            sock.sendall(
+                head.encode() + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                + payload
+            )
+            data = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        status, headers, body = _parse_response(data)
+        assert status == 200
+        assert headers["x-request-id"] == rid
+        events = _sse_events(body)
+        assert events[-1] == "[DONE]" and events[-2]["finish_reason"] == "length"
+
+        # the root "request" span ends in the finish callback on the event
+        # loop — give it a moment to land in the recorder
+        deadline = time.monotonic() + 10.0
+        spans = {}
+        while time.monotonic() < deadline:
+            spans = {
+                s["name"]: s for s in recorder.spans() if s["trace_id"] == rid
+            }
+            if "request" in spans:
+                break
+            time.sleep(0.02)
+        assert {
+            "request", "queue_wait", "prefill", "insert", "decode", "sse_flush"
+        } <= set(spans)
+        root = spans["request"]
+        assert root["parent_id"] is None
+        assert root["attrs"]["finish_reason"] == "length"
+        # cross-thread spans carry an explicit parent link to the root
+        assert spans["queue_wait"]["parent_id"] == root["span_id"]
+        assert spans["sse_flush"]["parent_id"] == root["span_id"]
+        # model-thread phases ran off the HTTP thread but share the trace
+        assert spans["prefill"]["thread"] != root["thread"]
+
+        # no header -> the server mints a fresh 16-hex id and echoes it
+        status2, headers2, _ = _http(
+            port, "POST", "/v1/generate", {"prompt": [5], "max_new_tokens": 2}
+        )
+        assert status2 == 200
+        rid2 = headers2["x-request-id"]
+        assert rid2 != rid and len(rid2) == 16
+        int(rid2, 16)  # hex

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Render span traces: tree view, per-phase percentages, p50/p95 tables.
+
+Reads either a flight-recorder dump (``flight_<reason>_<pid>.json``, written
+by ``relora_tpu.obs.flight.dump_on_fault``) or a JSONL span stream (one span
+dict per line — the trainer's ``RELORA_TPU_TRACE_DIR`` sink).  Prints:
+
+1. a span tree per trace (``--trace`` selects one; default: the few most
+   recent), children indented under parents, with duration and the share of
+   the root span's wall time;
+2. a phase summary across ALL loaded spans: count, total seconds, p50/p95,
+   and percentage of the total traced time per span name.
+
+``--chrome OUT.json`` additionally exports everything as Chrome trace-event
+JSON — open in chrome://tracing or https://ui.perfetto.dev, where it overlays
+with the XLA timelines StepProfiler writes.
+
+    python tools/trace_report.py ckpts/flight_sigterm_1234.json
+    python tools/trace_report.py traces/train_spans.jsonl --trace a1b2c3
+    python tools/trace_report.py dump.json --chrome /tmp/trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+# runnable from any cwd without an installed package
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from relora_tpu.obs.tracer import chrome_trace_events  # noqa: E402
+
+
+def load(path: str) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]], Dict[str, Any]]:
+    """Return (spans, events, header) from a flight dump or a JSONL stream."""
+    if path.endswith(".jsonl"):
+        spans = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    spans.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a killed writer
+        return spans, [], {"source": "jsonl"}
+    with open(path) as fh:
+        payload = json.load(fh)
+    header = {k: v for k, v in payload.items() if k not in ("spans", "events")}
+    return payload.get("spans", []), payload.get("events", []), header
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over raw durations (exact, not bucketed)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _fmt_attrs(attrs: Dict[str, Any], limit: int = 4) -> str:
+    if not attrs:
+        return ""
+    items = list(attrs.items())[:limit]
+    body = " ".join(f"{k}={v}" for k, v in items)
+    more = "" if len(attrs) <= limit else " …"
+    return f"  [{body}{more}]"
+
+
+def print_tree(spans: List[Dict[str, Any]], trace_id: str, out=sys.stdout) -> None:
+    trace = [s for s in spans if s.get("trace_id") == trace_id]
+    by_id = {s["span_id"]: s for s in trace}
+    children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for s in trace:
+        parent = s.get("parent_id")
+        # a parent evicted from the ring buffer orphans its children: show
+        # them at the root rather than dropping them
+        if parent not in by_id:
+            parent = None
+        children.setdefault(parent, []).append(s)
+    for group in children.values():
+        group.sort(key=lambda s: s.get("t_start") or 0.0)
+    roots = children.get(None, [])
+    total = sum(s.get("dur_s") or 0.0 for s in roots) or None
+    out.write(f"trace {trace_id}  ({len(trace)} spans)\n")
+
+    def walk(span: Dict[str, Any], depth: int) -> None:
+        dur = span.get("dur_s")
+        dur_txt = "open" if dur is None else f"{dur * 1e3:.2f} ms"
+        pct = ""
+        if total and dur is not None:
+            pct = f"  {100.0 * dur / total:5.1f}%"
+        out.write(
+            f"  {'  ' * depth}{span.get('name', '?')}  {dur_txt}{pct}"
+            f"{_fmt_attrs(span.get('attrs') or {})}\n"
+        )
+        for child in children.get(span["span_id"], []):
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+
+
+def phase_summary(spans: List[Dict[str, Any]], out=sys.stdout) -> None:
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        dur = s.get("dur_s")
+        if dur is not None:
+            by_name.setdefault(s.get("name", "?"), []).append(dur)
+    if not by_name:
+        out.write("no finished spans\n")
+        return
+    # % is of the summed time across all phases — sibling phases of one step
+    # roughly partition it, so the column reads as "where did the time go"
+    grand_total = sum(sum(v) for v in by_name.values())
+    out.write(
+        f"\n{'phase':<20} {'count':>6} {'total_s':>9} {'p50_ms':>9} "
+        f"{'p95_ms':>9} {'share':>7}\n"
+    )
+    for name, vals in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
+        vals.sort()
+        total = sum(vals)
+        out.write(
+            f"{name:<20} {len(vals):>6} {total:>9.3f} "
+            f"{percentile(vals, 0.50) * 1e3:>9.2f} "
+            f"{percentile(vals, 0.95) * 1e3:>9.2f} "
+            f"{100.0 * total / grand_total:>6.1f}%\n"
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="flight_*.json dump or *.jsonl span stream")
+    ap.add_argument("--trace", help="render only this trace id")
+    ap.add_argument(
+        "--max-traces", type=int, default=3,
+        help="without --trace: how many of the most recent traces to render",
+    )
+    ap.add_argument("--chrome", help="also export Chrome trace-event JSON here")
+    args = ap.parse_args(argv)
+
+    spans, events, header = load(args.path)
+    if header.get("reason"):
+        out = sys.stdout
+        out.write(
+            f"flight dump: reason={header['reason']} pid={header.get('pid')} "
+            f"dropped_spans={header.get('dropped_spans', 0)}\n\n"
+        )
+    if not spans and not events:
+        print("empty trace")
+        return 1
+
+    if args.trace:
+        trace_ids = [args.trace]
+    else:
+        seen: List[str] = []  # insertion order == recording order
+        for s in spans:
+            tid = s.get("trace_id")
+            if tid and tid not in seen:
+                seen.append(tid)
+        trace_ids = seen[-args.max_traces:]
+    for tid in trace_ids:
+        print_tree(spans, tid)
+    phase_summary(spans)
+
+    if args.chrome:
+        with open(args.chrome, "w") as fh:
+            json.dump({"traceEvents": chrome_trace_events(spans, events)}, fh)
+        print(f"\nchrome trace written to {args.chrome}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # downstream closed early (e.g. `| head`, `| grep -q`): not an error.
+        # Point stdout at devnull so the interpreter's exit-time flush of the
+        # dead pipe can't raise a second time.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
