@@ -66,6 +66,11 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
     "relora_tpu/obs/tracer.py": [""],
     "relora_tpu/obs/metrics.py": [""],
     "relora_tpu/obs/flight.py": [""],
+    # compile watcher wraps every jitted entry point (its __call__ runs per
+    # train update and per decode step); the memory poller is cadence-gated
+    # by contract — hot registration keeps device syncs out of both
+    "relora_tpu/obs/compile.py": [""],
+    "relora_tpu/obs/memory.py": [""],
 }
 
 HOT_MARKER = "relora-lint: hot-path"

@@ -1,12 +1,24 @@
 """relora_tpu.obs — unified observability: span tracing, shared metrics
-registry, flight recorder, and MFU helpers.
+registry, flight recorder, MFU helpers, HBM accounting, and compile
+telemetry.
 
-Stdlib-only (``mfu`` imports jax lazily and only for device detection);
-safe to import from the serving front-end, the trainer, and signal
-handlers.  See docs/observability.md.
+Stdlib-only at import time (``mfu`` / ``memory`` / ``compile`` import jax
+lazily, inside calls); safe to import from the serving front-end, the
+trainer, and signal handlers.  See docs/observability.md.
 """
 
+from relora_tpu.obs.compile import CompileEvent, CompileWatcher, abstract_signature, signature_diff
 from relora_tpu.obs.flight import FlightRecorder, configure, default_recorder, dump_on_fault
+from relora_tpu.obs.memory import (
+    MemoryPoller,
+    hbm_peak_gb,
+    live_memory_stats,
+    plan_for,
+    pytree_breakdown,
+    pytree_bytes,
+    reconcile,
+    xla_memory_plan,
+)
 from relora_tpu.obs.metrics import LATENCY_BUCKETS, Histogram, MetricsRegistry
 from relora_tpu.obs.mfu import peak_flops, step_flops_from_cost_analysis
 from relora_tpu.obs.tracer import (
@@ -20,6 +32,18 @@ from relora_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "CompileEvent",
+    "CompileWatcher",
+    "abstract_signature",
+    "signature_diff",
+    "MemoryPoller",
+    "hbm_peak_gb",
+    "live_memory_stats",
+    "plan_for",
+    "pytree_breakdown",
+    "pytree_bytes",
+    "reconcile",
+    "xla_memory_plan",
     "FlightRecorder",
     "configure",
     "default_recorder",

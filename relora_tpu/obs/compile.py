@@ -1,0 +1,249 @@
+"""Compilation telemetry: when jitted entry points compile, and why.
+
+The RTL1xx lint rules keep retrace *causes* out of the code statically; this
+module is their runtime counterpart.  A :class:`CompileWatcher` wraps jitted
+callables (the trainer's ``_train_step``, the engine's prefill / insert /
+decode) and tracks each call's **abstract signature** — the (treedef, per-leaf
+shape/dtype) fingerprint jit keys its cache on.  A call with a new signature
+is about to trace (and almost always compile); the watcher times it, emits a
+``compile`` span + metrics event, and classifies it:
+
+- **expected** — the first signature a wrapped function ever sees, or any
+  compile inside an :meth:`CompileWatcher.expected_compiles` block
+  (``engine.warmup`` wraps its pre-traffic compiles in one);
+- **steady-state retrace** — everything else: a shape-unstable input slipped
+  into the hot loop after warmup.  The ``compile_steady_state_retraces``
+  counter should stay at 0 for the whole run; docs/operations.md has the
+  triage recipe when it does not.
+
+Per-call overhead on the warm path is one ``tree_flatten`` of the argument
+*metadata* plus a set lookup — microseconds, no device work, no sync (the
+module is registered hot in analysis/hotpaths.py).  jax is imported lazily so
+``relora_tpu.obs`` stays import-light.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "CompileEvent",
+    "CompileWatcher",
+    "abstract_signature",
+    "signature_diff",
+]
+
+
+def abstract_signature(args: tuple, kwargs: dict) -> Tuple[Any, Tuple[str, ...]]:
+    """The (treedef, per-leaf "dtype(shape)") fingerprint of a call.
+
+    Matches what jit's dispatch cache keys on for our entry points: pytree
+    structure plus each array leaf's shape and dtype; non-array leaves
+    (static ints, floats, None) contribute their ``repr``.  The treedef is
+    returned as-is — PyTreeDef is hashable and cheap to compare, where
+    ``str(treedef)`` on a large param tree is not.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None and dtype is None:
+            sig.append(repr(leaf))
+        else:
+            sig.append(f"{dtype}{tuple(shape) if shape is not None else ''}")
+    return treedef, tuple(sig)
+
+
+def signature_diff(
+    prev: Optional[Tuple[str, ...]], new: Tuple[str, ...], limit: int = 8
+) -> List[str]:
+    """Human-readable leaf-level diff between two abstract signatures — the
+    first thing to read when a steady-state retrace fires (which argument
+    changed shape?)."""
+    if prev is None:
+        return []
+    out: List[str] = []
+    for i in range(max(len(prev), len(new))):
+        a = prev[i] if i < len(prev) else "<absent>"
+        b = new[i] if i < len(new) else "<absent>"
+        if a != b:
+            out.append(f"leaf[{i}]: {a} -> {b}")
+            if len(out) >= limit:
+                out.append("...")
+                break
+    if not out:
+        out.append("<structure changed, leaf shapes identical>")
+    return out
+
+
+@dataclass
+class CompileEvent:
+    """One observed compile (first call with a new abstract signature)."""
+
+    fn: str
+    expected: bool
+    reason: str  # "first_call" | an expected_compiles reason | "steady_state"
+    duration_s: float
+    n_leaves: int
+    signature: Tuple[str, ...] = field(repr=False)
+    changed: List[str] = field(default_factory=list)
+
+
+class CompileWatcher:
+    """Shared compile ledger for a set of wrapped jitted callables.
+
+    Sinks are all optional and may be attached after construction (the
+    trainer builds its MetricsLogger later than its jitted step):
+
+    - ``tracer`` — each compile becomes a ``compile`` span covering the
+      compiling call;
+    - ``registry`` — ``compile_total`` / ``compile_steady_state_retraces``
+      counters, labelled by function;
+    - ``metrics`` — a ``compile`` event per observation into metrics.jsonl,
+      which is what ``tools/perf_report.py`` reads.
+    """
+
+    def __init__(
+        self,
+        service: str = "app",
+        *,
+        tracer: Any = None,
+        registry: Any = None,
+        metrics: Any = None,
+    ):
+        self.service = service
+        self.tracer = tracer
+        self.registry = registry
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._events: List[CompileEvent] = []
+        self._last_sig: Dict[str, Tuple[str, ...]] = {}
+        self._first_seen: set = set()
+        self._expected_depth = 0
+        self._expected_reason = "expected"
+        self._retraces = 0
+
+    # -- classification -------------------------------------------------------
+
+    @contextlib.contextmanager
+    def expected_compiles(self, reason: str = "warmup"):
+        """Compiles inside this block are expected (warmup, memory plans)."""
+        with self._lock:
+            self._expected_depth += 1
+            prev = self._expected_reason
+            self._expected_reason = reason
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._expected_depth -= 1
+                self._expected_reason = prev
+
+    @property
+    def steady_state_retraces(self) -> int:
+        """Compiles observed after a function's first signature, outside any
+        ``expected_compiles`` block.  Healthy runs hold this at 0."""
+        return self._retraces
+
+    def compile_events(self) -> List[CompileEvent]:
+        return list(self._events)
+
+    def summary(self) -> Dict[str, Any]:
+        by_fn: Dict[str, int] = {}
+        for ev in self._events:
+            by_fn[ev.fn] = by_fn.get(ev.fn, 0) + 1
+        return {
+            "compiles": len(self._events),
+            "steady_state_retraces": self._retraces,
+            "by_fn": by_fn,
+        }
+
+    # -- wrapping -------------------------------------------------------------
+
+    def wrap(self, name: str, fn: Callable) -> "_WatchedFunction":
+        """Wrap a jitted callable; attribute access (``.lower``, ...) passes
+        through to the wrapped function."""
+        return _WatchedFunction(self, name, fn)
+
+    def _record(
+        self, name: str, sig: Tuple[str, ...], duration_s: float
+    ) -> CompileEvent:
+        with self._lock:
+            first = name not in self._first_seen
+            self._first_seen.add(name)
+            if first:
+                expected, reason = True, "first_call"
+            elif self._expected_depth > 0:
+                expected, reason = True, self._expected_reason
+            else:
+                expected, reason = False, "steady_state"
+                self._retraces += 1
+            changed = [] if first else signature_diff(self._last_sig.get(name), sig)
+            self._last_sig[name] = sig
+            event = CompileEvent(
+                fn=name,
+                expected=expected,
+                reason=reason,
+                duration_s=duration_s,
+                n_leaves=len(sig),
+                signature=sig,
+                changed=changed,
+            )
+            self._events.append(event)
+        if self.registry is not None:
+            self.registry.inc("compile_total", label=("fn", name))
+            if not expected:
+                self.registry.inc("compile_steady_state_retraces", label=("fn", name))
+        if self.metrics is not None:
+            self.metrics.event(
+                "compile",
+                fn=name,
+                service=self.service,
+                expected=expected,
+                reason=reason,
+                duration_s=round(duration_s, 4),
+                changed=changed,
+            )
+        return event
+
+
+class _WatchedFunction:
+    """Signature-tracking pass-through around one jitted callable."""
+
+    def __init__(self, watcher: CompileWatcher, name: str, fn: Callable):
+        self._watcher = watcher
+        self._name = name
+        self.__wrapped__ = fn
+        self._known: set = set()
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        treedef, sig = abstract_signature(args, kwargs)
+        key = (treedef, sig)
+        if key in self._known:
+            return self.__wrapped__(*args, **kwargs)
+        # new abstract signature: this call traces (and compiles, unless an
+        # identical program is already in-process).  The timed duration is
+        # trace + compile; execution is async-dispatched and not included.
+        watcher = self._watcher
+        t0 = time.monotonic()
+        if watcher.tracer is not None:
+            with watcher.tracer.span("compile", fn=self._name) as sp:
+                out = self.__wrapped__(*args, **kwargs)
+                self._known.add(key)
+                event = watcher._record(self._name, sig, time.monotonic() - t0)
+                sp.set(expected=event.expected, reason=event.reason)
+        else:
+            out = self.__wrapped__(*args, **kwargs)
+            self._known.add(key)
+            watcher._record(self._name, sig, time.monotonic() - t0)
+        return out
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.__wrapped__, item)

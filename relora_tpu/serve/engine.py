@@ -40,6 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
+from relora_tpu.obs import memory as obs_memory
+from relora_tpu.obs.compile import CompileWatcher
 from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, param_shardings
 from relora_tpu.serve.sampling import SamplingParams, sample
 
@@ -128,6 +130,7 @@ class InferenceEngine:
         attention_impl: str = "auto",
         mesh: Optional[Mesh] = None,
         lora: Optional[LoraSpec] = None,
+        compile_watcher: Optional[CompileWatcher] = None,
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
@@ -173,10 +176,15 @@ class InferenceEngine:
             return jax.tree_util.tree_map(ins, dcache, pcache)
 
         # the fresh prefill cache and the persistent decode cache are both
-        # donated: the step's output cache reuses the input buffers in place
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(3,))
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
-        self._insert = jax.jit(insert_fn, donate_argnums=(0,))
+        # donated: the step's output cache reuses the input buffers in place.
+        # The compile watcher tracks each entry point's abstract signatures:
+        # warmup() compiles are tagged expected, anything after counts toward
+        # compile_steady_state_retraces (docs/observability.md)
+        self.compile_watcher = compile_watcher or CompileWatcher(service="engine")
+        cw = self.compile_watcher
+        self._prefill = cw.wrap("prefill", jax.jit(prefill_fn, donate_argnums=(3,)))
+        self._decode = cw.wrap("decode", jax.jit(decode_fn, donate_argnums=(1,)))
+        self._insert = cw.wrap("insert", jax.jit(insert_fn, donate_argnums=(0,)))
         self._sample = jax.jit(sample, static_argnames=("top_k",))
 
     # -- cache construction --------------------------------------------------
@@ -245,22 +253,88 @@ class InferenceEngine:
         ``dcache`` is donated; ``slot`` is traced (no retrace per slot)."""
         return self._insert(dcache, pcache, jnp.asarray(slot, jnp.int32))
 
-    def warmup(self, batch: int, *, prompt_buckets: Sequence[int] = (16,)) -> None:
+    def warmup(self, batch: int, *, prompt_buckets: Sequence[int] = (16,)) -> dict:
         """Compile the serving step functions before traffic arrives: one
         prefill per prompt bucket, one insert, one decode at ``batch`` rows.
         An online server calls this at startup so the first real request
-        pays queueing latency, not XLA compilation."""
-        pcache = None
+        pays queueing latency, not XLA compilation.
+
+        Returns a report of what was compiled — the buckets and batch shapes
+        plus per-compile durations — so operators can log it and compile
+        telemetry can tell these expected compiles apart from steady-state
+        retraces (a prompt landing in an un-warmed bucket after this)."""
+        cw = self.compile_watcher
+        n_before = len(cw.compile_events())
+        buckets: List[int] = []
+        with cw.expected_compiles("warmup"):
+            pcache = None
+            for bucket in prompt_buckets:
+                T = min(bucket_length(bucket), self.cache_size)
+                if T not in buckets:
+                    buckets.append(T)
+                _, pcache = self.prefill(jnp.zeros((1, T), jnp.int32))
+            cache = self.init_cache(batch)
+            if pcache is not None:
+                cache = self.insert(cache, pcache, 0)
+            logits, cache = self.decode(
+                cache, jnp.zeros((batch, 1), jnp.int32), jnp.zeros((batch, 1), jnp.int32)
+            )
+            jax.block_until_ready(logits)
+        events = cw.compile_events()[n_before:]
+        return {
+            "batch": batch,
+            "prompt_buckets": buckets,
+            "shapes": {
+                "prefill": [[1, T] for T in buckets],
+                "insert": [[batch], [1]],
+                "decode": [batch, 1],
+            },
+            "n_compiles": len(events),
+            "compiles": [
+                {"fn": ev.fn, "duration_s": round(ev.duration_s, 4), "reason": ev.reason}
+                for ev in events
+            ],
+        }
+
+    def memory_plans(self, batch: int, *, prompt_buckets: Sequence[int] = (16,)) -> dict:
+        """Static HBM plans for every jitted serving entry point (per-bucket
+        prefill, insert, decode at ``batch`` rows) plus the per-pytree
+        breakdown of what stays resident (params, KV cache).
+
+        Uses AOT lower+compile, which does NOT warm the traced-call cache —
+        each plan pays a real compile (tagged expected), so call this at
+        startup or in reports, not per request.  Off-accelerator the XLA
+        numbers describe host buffers, but the relative breakdown holds."""
+        plans: dict = {
+            "pytree": obs_memory.pytree_breakdown(
+                {"params": self.params, "kv_cache": self.cache_shapes(batch)}
+            )
+        }
+        dcache = self.cache_shapes(batch)
+        pcache1 = self.cache_shapes(1)
+        i32 = jnp.int32
+        # AOT plans bypass __call__, so the watcher never sees them — no
+        # expected_compiles block needed
         for bucket in prompt_buckets:
             T = min(bucket_length(bucket), self.cache_size)
-            _, pcache = self.prefill(jnp.zeros((1, T), jnp.int32))
-        cache = self.init_cache(batch)
-        if pcache is not None:
-            cache = self.insert(cache, pcache, 0)
-        logits, cache = self.decode(
-            cache, jnp.zeros((batch, 1), jnp.int32), jnp.zeros((batch, 1), jnp.int32)
+            plans[f"prefill_b{T}"] = obs_memory.plan_for(
+                self._prefill,
+                self.params,
+                jax.ShapeDtypeStruct((1, T), i32),
+                jax.ShapeDtypeStruct((1, T), i32),
+                pcache1,
+            )
+        plans["insert"] = obs_memory.plan_for(
+            self._insert, dcache, pcache1, jax.ShapeDtypeStruct((), i32)
         )
-        jax.block_until_ready(logits)
+        plans["decode"] = obs_memory.plan_for(
+            self._decode,
+            self.params,
+            dcache,
+            jax.ShapeDtypeStruct((batch, 1), i32),
+            jax.ShapeDtypeStruct((batch, 1), i32),
+        )
+        return plans
 
     # -- convenience: one-shot batch generation ------------------------------
 

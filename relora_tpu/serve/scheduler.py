@@ -230,6 +230,10 @@ class ContinuousBatchingScheduler:
         Returns the requests that finished during the round (possibly at
         admission, when the first token already satisfies the request)."""
         finished: List[Completion] = []
+        # admission (prefill + insert) runs on the decode loop's critical
+        # path: its share of the step is the "prefill stall" every in-flight
+        # stream pays, reported per step next to the batch-fill ratio
+        t_step = time.monotonic()
         self._expire_deadlines(finished)
         while True:
             self._admit_pass(finished)
@@ -237,6 +241,7 @@ class ContinuousBatchingScheduler:
                 break
             # everything admitted this round finished at once; keep admitting
             # (mirrors the original drain loop's `continue` back to admission)
+        admit_s = time.monotonic() - t_step
         if not any(s is not None for s in self._slots):
             return finished
 
@@ -244,8 +249,9 @@ class ContinuousBatchingScheduler:
         # batch-level span (several requests share it): dispatch + the bulk
         # token pull, which is the step's device sync point
         t_decode = time.monotonic()
+        n_active = self.active_slots  # the batch this decode step runs over
         with self.tracer.span(
-            "decode_step", step=self._step_count, active_slots=self.active_slots
+            "decode_step", step=self._step_count, active_slots=n_active
         ):
             logits, self._cache = self.engine.decode(
                 self._cache,
@@ -256,7 +262,15 @@ class ContinuousBatchingScheduler:
             # one bulk pull for the whole batch, then plain Python ints —
             # per-slot int(next_tokens[i]) would be a device sync per row
             next_tokens = self._sample_rows(logits, self._slots).tolist()
-        self._observe("decode_step_seconds", time.monotonic() - t_decode)
+        decode_s = time.monotonic() - t_decode
+        self._observe("decode_step_seconds", decode_s)
+        # utilization attribution: how full the decode batch actually was,
+        # and what share of the step admissions stole from decoding
+        batch_fill = n_active / self.max_batch
+        stall_share = admit_s / max(admit_s + decode_s, 1e-9)
+        if self.obs_registry is not None:
+            self.obs_registry.set_gauge("batch_fill", batch_fill)
+            self.obs_registry.set_gauge("prefill_stall_share", stall_share)
         for slot_idx, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -268,11 +282,20 @@ class ContinuousBatchingScheduler:
             self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
             self._finish_if_done(slot_idx, finished)
         if self.metrics is not None:
+            watcher = getattr(self.engine, "compile_watcher", None)
             self.metrics.log(
                 {
                     "serve/decode_step": self._step_count,
                     "serve/queue_depth": len(self._pending),
                     "serve/active_slots": self.active_slots,
+                    "serve/batch_fill": round(batch_fill, 4),
+                    "serve/prefill_stall_s": round(admit_s, 6),
+                    "serve/prefill_stall_share": round(stall_share, 4),
+                    # a nonzero here after warmup means a shape escaped the
+                    # warmed buckets — see docs/operations.md troubleshooting
+                    "compile/steady_state_retraces": (
+                        watcher.steady_state_retraces if watcher is not None else 0
+                    ),
                 }
             )
         return finished

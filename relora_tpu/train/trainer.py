@@ -45,6 +45,8 @@ from relora_tpu.core.schedules import make_schedule
 from relora_tpu.models.llama import LlamaForCausalLM
 from relora_tpu.models.params_util import init_params, logical_partition_specs
 from relora_tpu.obs import flight
+from relora_tpu.obs import memory as obs_memory
+from relora_tpu.obs.compile import CompileWatcher
 from relora_tpu.obs.metrics import MetricsRegistry
 from relora_tpu.obs.mfu import peak_flops, step_flops_from_cost_analysis
 from relora_tpu.obs.tracer import Tracer
@@ -94,6 +96,22 @@ def _pull_metric_records(metric_dicts):
         {k: (int(v) if k in _INT_METRICS else float(v)) for k, v in d.items()}
         for d in host
     ]
+
+
+def _fence_metrics(metric_dicts) -> float:
+    """Wait for the newest pending metric dict to finish computing and return
+    the wait in seconds — the "compute" share of the mfu_gap waterfall.
+
+    Lives outside the hot functions (RTL203) for the same reason as
+    ``_pull_metric_records``: it runs once per ``log_every`` flush, right
+    before the bulk pull, so it splits the sync the flush already pays into
+    a device-wait part and a transfer part without adding a new sync point.
+    The newest dict depends on every preceding step's params, so this one
+    fence covers the whole window.
+    """
+    t0 = time.perf_counter()
+    jax.block_until_ready(metric_dicts[-1])
+    return time.perf_counter() - t0
 
 
 def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingConfig):
@@ -418,6 +436,27 @@ class Trainer:
             jsonl_path=os.path.join(trace_dir, "train_spans.jsonl") if trace_dir else None,
         )
         self.obs = MetricsRegistry(namespace="relora_train")
+        # compile telemetry: the wrapped step tracks its abstract call
+        # signatures — a recompile after the first one is a steady-state
+        # retrace (compile_steady_state_retraces counter, `compile` events
+        # in metrics.jsonl; see docs/observability.md)
+        self.compile_watcher = CompileWatcher(
+            service="train", tracer=self.tracer, registry=self.obs, metrics=self.metrics
+        )
+        self._train_step = self.compile_watcher.wrap("train_step", self._train_step)
+        # HBM accounting: live gauges polled at the metric-flush cadence, and
+        # the per-pytree plan (what the resident state occupies) emitted once
+        self._mem_poller = obs_memory.MemoryPoller(registry=self.obs)
+        self._memory_plan = obs_memory.pytree_breakdown(
+            {"params": self.state.params, "opt_state": self.state.opt_state}
+        )
+        self.metrics.event(
+            "memory_plan",
+            step=self.update_step,
+            source="pytree",
+            **self._memory_plan,
+            **{f"live_{k}": v for k, v in self._mem_poller.poll().items()},
+        )
         if cfg.save_dir:
             flight.configure(dump_dir=cfg.save_dir)
         # live MFU: measured step FLOPs (XLA cost_analysis, filled in lazily
@@ -542,7 +581,13 @@ class Trainer:
         Runs once, lazily, on the first real batch (abstract lowering only —
         no compile, no device work).  Returns None when the backend offers no
         cost model or ``RELORA_TPU_LIVE_MFU=0``; the MFU gauge then falls
-        back to the 6ND analytic estimate (docs/observability.md)."""
+        back to the 6ND analytic estimate (docs/observability.md).
+
+        Side effect: reuses the lowering for the train step's static HBM plan
+        (``compiled.memory_analysis()`` -> a ``memory_plan`` event).  That
+        path DOES compile, and an AOT compile does not warm the traced-call
+        cache — ``RELORA_TPU_MEM_PLAN=0`` skips it where a duplicate compile
+        of a big model is too expensive."""
         if os.environ.get("RELORA_TPU_LIVE_MFU", "1") == "0":
             return None
         try:
@@ -556,6 +601,22 @@ class Trainer:
         except Exception as e:  # backend-specific; never fail the run over MFU
             logger.info(f"live MFU: cost_analysis unavailable ({e}); using 6ND estimate")
             return None
+        if os.environ.get("RELORA_TPU_MEM_PLAN", "1") != "0":
+            try:
+                with self.mesh, self.compile_watcher.expected_compiles("memory_plan"):
+                    plan = obs_memory.xla_memory_plan(lowered.compile())
+                if plan:
+                    recon = obs_memory.reconcile(plan.get("plan_total_bytes"))
+                    recon.pop("plan_total_bytes", None)  # already in the plan
+                    self.metrics.event(
+                        "memory_plan",
+                        step=self.update_step,
+                        source="xla_train_step",
+                        **plan,
+                        **recon,
+                    )
+            except Exception as e:  # a plan must never fail the run
+                logger.info(f"HBM plan: memory_analysis unavailable ({e})")
         if flops:
             logger.info(f"live MFU: measured step cost {flops:.3e} FLOPs (cost_analysis)")
         return flops
@@ -614,20 +675,56 @@ class Trainer:
         # (_pull_metric_records).  The NaN-abort check runs on materialized
         # values, so it lags by the same bound — a few extra steps before an
         # abort is harmless.
-        pending: list = []  # (metrics, update_step, global_step, tokens, dt, counters)
+        pending: list = []  # (metrics, update_step, global_step, tokens, dt, counters, span_s)
+        window_t0 = time.perf_counter()  # mfu_gap waterfall window start
 
         def flush_pending() -> bool:
             """Log all lagged metric records; returns False if training must
             abort.  One bulk device pull for the whole batch — keep
-            float()/int() on device values out of here (RTL202)."""
-            nonlocal spike
+            float()/int() on device values out of here (RTL202).
+
+            Also emits the mfu_gap waterfall for the flushed window: the
+            flush's single sync is split into a device-wait fence (the
+            "compute" share) and the transfer, and the window's wall time is
+            partitioned into data_fetch / dispatch / compute / host shares
+            that sum to ~100% by construction (host is the residual:
+            transfer, logging, python, and any eval/checkpoint cadence work
+            that landed in the window)."""
+            nonlocal spike, window_t0
             if not pending:
                 return True
             with self.tracer.span("metric_pull", n_records=len(pending)):
-                records = _pull_metric_records([p[0] for p in pending])
+                devs = [p[0] for p in pending]
+                compute_s = _fence_metrics(devs)
+                records = _pull_metric_records(devs)
             batch = [(m, *rest) for m, (_, *rest) in zip(records, pending)]
             pending.clear()
-            for metrics, at_step, at_global, tokens_in_update, dt, counters in batch:
+            now = time.perf_counter()
+            wall = now - window_t0
+            window_t0 = now
+            data_s = sum(b[-1][0] for b in batch)
+            disp_s = sum(b[-1][1] for b in batch)
+            if wall > 0:
+                host_s = max(0.0, wall - data_s - disp_s - compute_s)
+                gap = {
+                    "mfu_gap/window_steps": len(batch),
+                    "mfu_gap/wall_s": round(wall, 4),
+                    "mfu_gap/data_fetch": round(min(1.0, data_s / wall), 4),
+                    "mfu_gap/dispatch": round(min(1.0, disp_s / wall), 4),
+                    "mfu_gap/compute": round(min(1.0, compute_s / wall), 4),
+                    "mfu_gap/host": round(min(1.0, host_s / wall), 4),
+                    "compile/steady_state_retraces": self.compile_watcher.steady_state_retraces,
+                }
+                for key in ("data_fetch", "dispatch", "compute", "host"):
+                    self.obs.set_gauge(f"mfu_gap_{key}", gap[f"mfu_gap/{key}"])
+                # live HBM gauges at the same cadence (no-op on CPU; the
+                # poller must never run inside the per-step loop)
+                mem = self._mem_poller.poll()
+                if mem["available"]:
+                    gap["hbm/bytes_in_use"] = mem["bytes_in_use"]
+                    gap["hbm/peak_bytes_in_use"] = mem["peak_bytes_in_use"]
+                self.metrics.log(gap, step=batch[-1][2])
+            for metrics, at_step, at_global, tokens_in_update, dt, counters, _span_s in batch:
                 if metrics["skipped"]:
                     logger.error(
                         f"NaN update skipped at step {at_step} "
@@ -689,7 +786,7 @@ class Trainer:
                   # fetches in the header, outside any span).  Two-space nesting
                   # keeps the loop body's indentation unchanged.
                   with self.tracer.span("update_step", step=self.update_step):
-                    with self.tracer.span("data_fetch"):
+                    with self.tracer.span("data_fetch") as sp_fetch:
                         batch = next(batches, None)
                     if batch is None:
                         break  # data ran out; exhausted stays True (for-else parity)
@@ -715,7 +812,7 @@ class Trainer:
                         self._step_flops = self._measure_step_flops(
                             batch, jax.random.fold_in(rng, self.update_step)
                         )
-                    with self.tracer.span("dispatch", step=self.update_step):
+                    with self.tracer.span("dispatch", step=self.update_step) as sp_dispatch:
                         # async dispatch: this span is enqueue cost, not device
                         # step time — the blocking pull happens in metric_pull
                         self.state, metrics = self._train_step(
@@ -864,6 +961,9 @@ class Trainer:
                                 "n_lora_restarts": self.n_lora_restarts,
                                 "n_optimizer_resets": self.n_optimizer_resets,
                             },
+                            # per-step host-side time for the mfu_gap
+                            # waterfall (spans are closed by here)
+                            (sp_fetch.duration_s or 0.0, sp_dispatch.duration_s or 0.0),
                         )
                     )
                     if prof is not None:

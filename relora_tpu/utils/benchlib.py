@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from relora_tpu.obs.memory import hbm_peak_gb as obs_hbm_peak_gb
 from relora_tpu.obs.mfu import PEAK_FLOPS_DEFAULT
 from relora_tpu.obs.mfu import peak_flops as detect_peak_flops
 
@@ -129,12 +130,9 @@ def run_throughput_bench(
 
     tokens_per_update = grad_accum * micro_batch * seq
     tokens_per_sec = tokens_per_update * measure_steps / dt
-    try:
-        stats = jax.devices()[0].memory_stats() or {}
-        peak = stats.get("peak_bytes_in_use")
-        hbm_peak_gb = round(peak / 1e9, 2) if peak is not None else None
-    except Exception:
-        hbm_peak_gb = None
+    # one schema for CPU and TPU: obs/memory normalizes the backends that
+    # keep no allocator stats (CPU) to None instead of a raw `or {}` dance
+    hbm_peak_gb = obs_hbm_peak_gb(jax.devices()[0])
     # 6*N per token fwd+bwd on the dense (equivalent) params
     n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
     if peak_flops is None:

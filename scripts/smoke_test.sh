@@ -166,4 +166,19 @@ grep -q "dispatch" "$WORK/trace_report.txt"
 # the JSONL sink recorded the same spans and renders too
 python tools/trace_report.py "$WORK/traces/train_spans.jsonl" --max-traces 1 | grep -q "update_step"
 
+echo "=== 11. perf attribution report + bench regression gate ==="
+# a short clean traced run (no fault injection): the report must render the
+# MFU-gap waterfall and HBM plan, and the steady state must be retrace-free
+RELORA_TPU_TRACE_DIR="$WORK/traces11" RELORA_TPU_MEM_PLAN=1 \
+python main.py "${common[@]}" --lr 3e-3 --scheduler cosine --cycle_length 8 \
+    --num_training_steps 8 --log_every 4 --save_every 100 --save_dir "$WORK/perf"
+python tools/perf_report.py "$WORK/perf" --traces "$WORK/traces11/train_spans.jsonl" \
+    --assert-no-retraces | tee "$WORK/perf_report.txt"
+grep -q "MFU-gap waterfall" "$WORK/perf_report.txt"
+grep -q "per-pytree" "$WORK/perf_report.txt"
+grep -q "steady-state retraces: 0" "$WORK/perf_report.txt"
+# the gate passes on the committed BENCH trajectory; warn-only off-TPU
+# because CPU numbers swing with machine load
+python tools/bench_gate.py --check --warn-only
+
 echo "SMOKE OK"

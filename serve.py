@@ -158,10 +158,25 @@ def main(argv=None) -> int:
         from relora_tpu.serve.server import run_server
         from relora_tpu.utils.logging import MetricsLogger
 
+        metrics = MetricsLogger(run_dir=args.run_dir) if args.run_dir else None
         if not args.no_warmup:
             logger.info("warming serving compiles (disable with --no-warmup)")
-            engine.warmup(args.max_batch)
-        metrics = MetricsLogger(run_dir=args.run_dir) if args.run_dir else None
+            report = engine.warmup(args.max_batch)
+            timings = ", ".join(
+                f"{c['fn']} {c['duration_s']:.2f}s" for c in report["compiles"]
+            )
+            logger.info(
+                f"warmup compiled {report['n_compiles']} programs "
+                f"(prompt buckets {report['prompt_buckets']}, "
+                f"decode batch {report['batch']}): {timings}"
+            )
+            if metrics is not None:
+                metrics.event(
+                    "warmup",
+                    batch=report["batch"],
+                    prompt_buckets=report["prompt_buckets"],
+                    n_compiles=report["n_compiles"],
+                )
         scheduler = ContinuousBatchingScheduler(
             engine,
             max_batch=args.max_batch,

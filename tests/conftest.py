@@ -38,6 +38,12 @@ else:
     # Tests that exercise the knob monkeypatch the env var explicitly.
     os.environ.setdefault("RELORA_TPU_COMPILE_CACHE", "0")
 
+# The trainer's static HBM plan (obs/memory.plan_for) pays a duplicate AOT
+# compile of the train step — harmless in real runs, but it would double the
+# compile cost of every Trainer-constructing test.  Default it off; the perf
+# attribution integration test monkeypatches it back on.
+os.environ.setdefault("RELORA_TPU_MEM_PLAN", "0")
+
 import pytest  # noqa: E402
 
 

@@ -388,3 +388,56 @@ def test_trace_report_reads_jsonl_stream(tmp_path):
         capture_output=True, text=True, check=True,
     ).stdout
     assert "outer" in out and "inner" in out
+
+
+# ---------------------------------------------------------------------------
+# MFU edge cases: the 6ND fallback path and peak-FLOPs resolution corners
+
+
+def test_peak_flops_device_without_kind_and_env_precedence(monkeypatch):
+    monkeypatch.delenv("RELORA_TPU_PEAK_FLOPS", raising=False)
+    # a device object with no device_kind attribute at all -> default
+    assert peak_flops(object()) == PEAK_FLOPS_DEFAULT
+    assert peak_flops(_FakeDevice("")) == PEAK_FLOPS_DEFAULT
+    assert peak_flops(_FakeDevice("made-up accelerator 9000")) == PEAK_FLOPS_DEFAULT
+    # the env override wins over everything, including unknown kinds
+    monkeypatch.setenv("RELORA_TPU_PEAK_FLOPS", "42e12")
+    assert peak_flops(object()) == 42e12
+    assert peak_flops(None) == 42e12
+
+
+def test_step_flops_from_cost_analysis_hostile_inputs():
+    # non-iterable / wrong-typed cost objects must signal fallback, not raise
+    assert step_flops_from_cost_analysis(42) is None
+    assert step_flops_from_cost_analysis("flops") is None
+    assert step_flops_from_cost_analysis([{"flops": "NaN-ish"}]) is None
+    assert step_flops_from_cost_analysis([None, {"flops": 7.0}]) == 7.0
+
+
+def test_trainer_measure_step_flops_falls_back_to_6nd_when_lower_raises():
+    """When lowering/cost_analysis blows up, _measure_step_flops returns None
+    (the live-MFU gauge then uses the 6ND analytic estimate) instead of
+    failing the run."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from relora_tpu.train.trainer import Trainer
+
+    class BadStep:
+        def lower(self, *a, **k):
+            raise RuntimeError("backend exploded")
+
+    tr = Trainer.__new__(Trainer)  # no __init__: only the fields the method reads
+    tr.mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+    tr._train_step = BadStep()
+    tr.state = {"params": np.ones((2,), np.float32)}
+    assert tr._measure_step_flops(np.zeros((1, 2, 4), np.int32), jax.random.PRNGKey(0)) is None
+
+
+def test_trainer_measure_step_flops_honors_live_mfu_kill_switch(monkeypatch):
+    from relora_tpu.train.trainer import Trainer
+
+    monkeypatch.setenv("RELORA_TPU_LIVE_MFU", "0")
+    tr = Trainer.__new__(Trainer)  # the kill switch returns before any field use
+    assert tr._measure_step_flops(None, None) is None

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Thresholded regression gate over the committed BENCH_* trajectory.
+
+Three rules, each skipped gracefully when its input files are absent:
+
+1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
+   measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
+   ignored) must be within ``--tolerance`` (default 10%) of the best
+   previous real round.  A fresh regression shows up as the newest value
+   dropping below ``best * (1 - tolerance)``.
+2. **serving latency** (``BENCH_http.json`` vs ``tools/bench_baselines.json``):
+   per-level ``ttft_p95_ms`` / ``tpot_p95_ms`` must stay under the committed
+   caps (baseline p95 x (1 + tolerance), pre-expanded in the baselines file
+   with generous CPU-noise margins).
+3. **obs overhead** (``BENCH_obs.json``): ``detail.within_budget`` must be
+   true — the span tracer's measured overhead stayed inside its budget_pct.
+
+Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
+``--warn-only`` reports failures but exits 0 — CI uses it off-TPU where the
+numbers are load-noisy.
+
+    python tools/bench_gate.py --check
+    python tools/bench_gate.py --check --dir /path/to/benches --tolerance 0.15
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+BASELINES_PATH = Path(__file__).resolve().parent / "bench_baselines.json"
+
+
+def _load(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def real_rounds(bench_dir: str) -> List[Tuple[int, float]]:
+    """(round_n, tok/s) for every round with a real measurement, sorted by n.
+    Watchdog/stalled rounds (value <= 0) carry no signal and are dropped."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r[0-9]*.json")):
+        doc = _load(path)
+        if not doc:
+            continue
+        value = (doc.get("parsed") or {}).get("value")
+        if isinstance(value, (int, float)) and value > 0:
+            rounds.append((int(doc.get("n", 0)), float(value)))
+    rounds.sort()
+    return rounds
+
+
+def check_train(bench_dir: str, tolerance: float) -> List[str]:
+    rounds = real_rounds(bench_dir)
+    if len(rounds) < 2:
+        return []  # nothing to compare against yet
+    *prev, (latest_n, latest) = rounds
+    best_n, best = max(prev, key=lambda r: r[1])
+    floor = best * (1.0 - tolerance)
+    if latest < floor:
+        return [
+            f"train tok/s: round {latest_n} = {latest:,.1f} is "
+            f"{(1 - latest / best) * 100:.1f}% below best round {best_n} "
+            f"({best:,.1f}); floor at {tolerance * 100:.0f}% is {floor:,.1f}"
+        ]
+    return []
+
+
+def check_http(bench_dir: str, baselines: Optional[Dict[str, Any]]) -> List[str]:
+    doc = _load(os.path.join(bench_dir, "BENCH_http.json"))
+    if not doc or not baselines:
+        return []
+    caps = baselines.get("http_p95_caps_ms") or {}
+    failures = []
+    for level in (doc.get("detail") or {}).get("levels") or []:
+        cap = caps.get(str(level.get("offered")))
+        if not cap:
+            continue
+        for key in ("ttft_p95_ms", "tpot_p95_ms"):
+            got, limit = level.get(key), cap.get(key)
+            if isinstance(got, (int, float)) and isinstance(limit, (int, float)) and got > limit:
+                failures.append(
+                    f"http {level['offered']}: {key} = {got:.1f}ms exceeds cap {limit:.1f}ms"
+                )
+    return failures
+
+
+def check_obs(bench_dir: str) -> List[str]:
+    doc = _load(os.path.join(bench_dir, "BENCH_obs.json"))
+    if not doc:
+        return []
+    detail = doc.get("detail") or {}
+    if detail.get("within_budget") is False:
+        return [
+            f"obs overhead: {doc.get('value')}% of step time exceeds "
+            f"budget {detail.get('budget_pct')}%"
+        ]
+    return []
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true", help="run the gate (the only mode)")
+    ap.add_argument(
+        "--dir",
+        default=str(Path(__file__).resolve().parents[1]),
+        help="directory holding BENCH_*.json (default: repo root)",
+    )
+    ap.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.10,
+        help="allowed fractional drop in train tok/s vs the best previous round",
+    )
+    ap.add_argument(
+        "--baselines",
+        default=str(BASELINES_PATH),
+        help="serving-latency caps JSON ('' disables the http rule)",
+    )
+    ap.add_argument(
+        "--warn-only",
+        action="store_true",
+        help="report regressions but exit 0 (off-TPU CI, where numbers are noisy)",
+    )
+    args = ap.parse_args(argv)
+    if not args.check:
+        ap.print_help()
+        return 2
+
+    baselines = _load(args.baselines) if args.baselines else None
+    failures = (
+        check_train(args.dir, args.tolerance)
+        + check_http(args.dir, baselines)
+        + check_obs(args.dir)
+    )
+
+    rounds = real_rounds(args.dir)
+    traj = " -> ".join(f"r{n}:{v:,.0f}" for n, v in rounds) or "no real rounds"
+    print(f"bench gate over {args.dir}  (train trajectory: {traj})")
+    if failures:
+        for f in failures:
+            print(f"  REGRESSION: {f}")
+        if args.warn_only:
+            print("bench gate: FAILURES above (warn-only: exit 0)")
+            return 0
+        print("bench gate: FAIL")
+        return 1
+    print("bench gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
