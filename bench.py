@@ -369,6 +369,17 @@ def serve_load_main() -> None:
     duration = float(os.environ.get("BENCH_HTTP_DURATION", "2.0"))
     prompt_len = int(os.environ.get("BENCH_HTTP_PROMPT_LEN", "8"))
     new_tokens = int(os.environ.get("BENCH_HTTP_NEW_TOKENS", "16"))
+    # paged serving (default): page-pool KV cache with chunked prefill and
+    # prefix caching; BENCH_HTTP_PAGED=0 measures the contiguous baseline
+    paged = os.environ.get("BENCH_HTTP_PAGED", "1") != "0"
+    page_size = int(os.environ.get("BENCH_HTTP_PAGE_SIZE", "16"))
+    num_pages_env = int(os.environ.get("BENCH_HTTP_NUM_PAGES", "0"))
+    chunk_size = int(os.environ.get("BENCH_HTTP_CHUNK", "64"))
+    # long+short mix: every Nth request carries a long prompt that opens
+    # with a shared system prefix, so the paged run exercises chunked
+    # prefill AND prefix-cache reuse under load
+    long_prompt_len = int(os.environ.get("BENCH_HTTP_LONG_PROMPT_LEN", str(4 * prompt_len)))
+    long_share = float(os.environ.get("BENCH_HTTP_LONG_SHARE", "0.25"))
 
     import jax
     import jax.numpy as jnp
@@ -376,16 +387,30 @@ def serve_load_main() -> None:
     from relora_tpu.config.model import load_model_config
     from relora_tpu.models.params_util import init_params
     from relora_tpu.serve.engine import InferenceEngine, build_decode_model
-    from relora_tpu.serve.scheduler import ContinuousBatchingScheduler
+    from relora_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        PagedContinuousBatchingScheduler,
+    )
     from relora_tpu.serve.server import GenerateServer
 
     cfg = load_model_config(model_name)
-    cache_size = 1 << (prompt_len + new_tokens + 8 - 1).bit_length()
+    max_prompt = max(prompt_len, long_prompt_len if long_share > 0 else 0)
+    cache_size = 1 << (max_prompt + new_tokens + 8 - 1).bit_length()
     model = build_decode_model(cfg, cache_size=cache_size)
     params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
-    engine = InferenceEngine(cfg, params, cache_size=cache_size)
-    engine.warmup(max_batch, prompt_buckets=(prompt_len,))
-    scheduler = ContinuousBatchingScheduler(engine, max_batch=max_batch)
+    if paged:
+        num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
+        engine = InferenceEngine(
+            cfg, params, cache_size=cache_size,
+            page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
+        )
+        engine.warmup(max_batch)
+        scheduler = PagedContinuousBatchingScheduler(engine, max_batch=max_batch)
+    else:
+        engine = InferenceEngine(cfg, params, cache_size=cache_size)
+        buckets = sorted({prompt_len} | ({long_prompt_len} if long_share > 0 else set()))
+        engine.warmup(max_batch, prompt_buckets=tuple(buckets))
+        scheduler = ContinuousBatchingScheduler(engine, max_batch=max_batch)
     server = GenerateServer(scheduler, port=0, max_queue=max_queue)
 
     rng = np.random.RandomState(0)
@@ -393,10 +418,23 @@ def serve_load_main() -> None:
         [int(t) for t in rng.randint(0, cfg.vocab_size, size=prompt_len)]
         for _ in range(64)
     ]
+    # long prompts: identical system prefix (half the length) + random tail
+    system_prefix = [int(t) for t in rng.randint(0, cfg.vocab_size, size=long_prompt_len // 2)]
+    long_prompts = [
+        system_prefix
+        + [int(t) for t in rng.randint(0, cfg.vocab_size, size=long_prompt_len - len(system_prefix))]
+        for _ in range(16)
+    ]
+    long_every = int(round(1.0 / long_share)) if long_share > 0 else 0
+
+    def pick_prompt(i: int) -> list:
+        if long_every and i % long_every == 0:
+            return long_prompts[(i // long_every) % len(long_prompts)]
+        return prompts[i % len(prompts)]
 
     async def one_request(i: int) -> dict:
         payload = {
-            "prompt": prompts[i % len(prompts)],
+            "prompt": pick_prompt(i),
             "max_new_tokens": new_tokens,
             "stream": True,
         }
@@ -497,6 +535,37 @@ def serve_load_main() -> None:
         await asyncio.gather(*(worker(w) for w in range(workers)))
         return summarize(f"closed:{workers}", results, time.perf_counter() - t0)
 
+    def level_paging_stats(before: dict) -> dict:
+        """Per-level pool pressure: peak utilization since the level started
+        plus the level's own prefix-cache hit rate (counter deltas)."""
+        alloc = scheduler.allocator
+        stats = {
+            "kv_pages_peak": alloc.peak_used,
+            "kv_pages_total": alloc.num_pages - 1,  # null page is not usable
+            "cache_utilization_peak": round(alloc.peak_used / (alloc.num_pages - 1), 4),
+        }
+        pc = scheduler.prefix_cache
+        if pc is not None:
+            lookups = pc.lookups - before["lookups"]
+            hits = pc.hits - before["hits"]
+            stats["prefix_lookups"] = lookups
+            stats["prefix_hits"] = hits
+            stats["prefix_hit_rate"] = round(hits / max(lookups, 1), 4)
+        return stats
+
+    async def run_level(coro) -> dict:
+        if not paged:
+            return await coro
+        pc = scheduler.prefix_cache
+        before = {
+            "lookups": pc.lookups if pc is not None else 0,
+            "hits": pc.hits if pc is not None else 0,
+        }
+        scheduler.allocator.peak_used = scheduler.allocator.used_pages
+        row = await coro
+        row["paging"] = level_paging_stats(before)
+        return row
+
     async def bench() -> list:
         serve_task = asyncio.ensure_future(
             server.serve_forever(install_signal_handlers=False)
@@ -507,8 +576,8 @@ def serve_load_main() -> None:
                 serve_task.result()  # surface startup errors
         rows = []
         for qps in qps_levels:
-            rows.append(await open_loop(qps))
-        rows.append(await closed_loop(max_batch + max_queue))
+            rows.append(await run_level(open_loop(qps)))
+        rows.append(await run_level(closed_loop(max_batch + max_queue)))
         server.begin_drain()
         await serve_task
         return rows
@@ -519,7 +588,8 @@ def serve_load_main() -> None:
     result = {
         "bench": "serve_load",
         "metric": f"{model_name} HTTP serving peak throughput "
-        f"(max_batch={max_batch}, max_queue={max_queue})",
+        f"({'paged' if paged else 'contiguous'} KV, "
+        f"max_batch={max_batch}, max_queue={max_queue})",
         "value": peak["throughput_tokens_per_s"],
         "unit": "tokens/sec",
         "detail": {
@@ -528,8 +598,20 @@ def serve_load_main() -> None:
             "max_batch": max_batch,
             "max_queue": max_queue,
             "prompt_len": prompt_len,
+            "long_prompt_len": long_prompt_len if long_share > 0 else 0,
+            "long_share": long_share,
             "new_tokens": new_tokens,
             "duration_s_per_level": duration,
+            "paged": paged,
+            **(
+                {
+                    "page_size": page_size,
+                    "num_pages": engine.num_pages,
+                    "chunk_size": engine.chunk_size,
+                }
+                if paged
+                else {}
+            ),
             "reject_rate_at_saturation": saturated["reject_rate"],
             "levels": rows,
         },

@@ -41,17 +41,32 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "Trainer._prefetched",
         "Trainer.evaluate",  # per-batch eval loop (syncs every sync_every)
     ],
+    # decode attention (contiguous + paged gather) traces inside every
+    # decode step; a host sync here stalls every active stream
+    "relora_tpu/ops/attention.py": [
+        "cached_attention",
+        "gather_kv_pages",
+        "paged_cached_attention",
+    ],
     "relora_tpu/serve/engine.py": [
         "InferenceEngine.prefill",
         "InferenceEngine.decode",
         "InferenceEngine.insert",
         "InferenceEngine.init_cache",
+        "InferenceEngine.prefill_chunk",  # paged: once per round
+        "InferenceEngine.decode_paged",  # paged: every decode step
+        "InferenceEngine.init_pool",
     ],
     "relora_tpu/serve/sampling.py": [""],  # jitted per decode step
+    # serve/paging.py carries the HOT_MARKER comment instead of an entry
+    # here: the allocator + prefix cache run on every paged admit/retire
     "relora_tpu/serve/scheduler.py": [
         "ContinuousBatchingScheduler.run",  # the drain loop
         "ContinuousBatchingScheduler.step",  # one admit-plus-decode round
         "ContinuousBatchingScheduler._sample_rows",  # per decode step
+        "PagedContinuousBatchingScheduler.step",  # one budgeted round
+        "PagedContinuousBatchingScheduler._admit_pass",  # per round
+        "PagedContinuousBatchingScheduler._prefill_pass",  # per round
     ],
     # the HTTP front-end's model thread calls scheduler.step() in a loop; a
     # stray sync there stalls every in-flight stream.  The asyncio handlers

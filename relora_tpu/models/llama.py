@@ -30,7 +30,11 @@ from flax import linen as nn
 from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
 from relora_tpu.models.lora import LoRALinear
-from relora_tpu.ops.attention import cached_attention, dot_product_attention
+from relora_tpu.ops.attention import (
+    cached_attention,
+    dot_product_attention,
+    paged_cached_attention,
+)
 
 
 def attend_with_cache(
@@ -66,6 +70,46 @@ def attend_with_cache(
     ck.value = jax.vmap(write)(ck.value, k_new.astype(ck.value.dtype), positions[:, 0])
     cv.value = jax.vmap(write)(cv.value, v_new.astype(cv.value.dtype), positions[:, 0])
     return cached_attention(q, ck.value, cv.value, positions)
+
+
+def attend_with_paged_cache(
+    module: nn.Module,
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+) -> jax.Array:
+    """Paged twin of :func:`attend_with_cache`: K/V pages live in one shared
+    pool ("cache" collection, shape (num_pages, page_size, n_kv, head_dim) —
+    no batch axis) and each row reaches its entries through ``block_tables``
+    (B, W), W = cache_size // page_size.  This call's K/V scatter to
+    ``pool[table[b, pos // page_size], pos % page_size]``; attention gathers
+    the row's logical cache back out (ops/attention.paged_cached_attention).
+
+    A logical page index beyond the row's table width clips to the last
+    column, and padded table entries hold the null page (serve/paging.py) —
+    so garbage writes from idle decode rows and chunk padding land where
+    nothing ever reads unmasked.  Under ``nn.scan`` the pool stacks on the
+    leading "layers" axis, exactly like the contiguous cache.
+    """
+    B, T = q.shape[:2]
+    ps, num_pages = module.page_size, module.num_pages
+    if num_pages < 2:
+        raise ValueError("paged decode requires num_pages >= 2 (page 0 is the null page)")
+    if block_tables is None:
+        raise ValueError("paged decode requires block_tables (got None)")
+    n_kv, hd = k_new.shape[2], k_new.shape[3]
+    ck = module.variable("cache", "k", jnp.zeros, (num_pages, ps, n_kv, hd), k_new.dtype)
+    cv = module.variable("cache", "v", jnp.zeros, (num_pages, ps, n_kv, hd), v_new.dtype)
+    positions = jnp.broadcast_to(positions, (B, T)).astype(jnp.int32)
+    W = block_tables.shape[1]
+    logical = jnp.clip(positions // ps, 0, W - 1)
+    rows = jnp.take_along_axis(block_tables, logical, axis=1)  # (B, T) pool pages
+    offs = positions % ps
+    ck.value = ck.value.at[rows, offs].set(k_new.astype(ck.value.dtype))
+    cv.value = cv.value.at[rows, offs].set(v_new.astype(cv.value.dtype))
+    return paged_cached_attention(q, ck.value, cv.value, block_tables, positions)
 
 
 class RMSNorm(nn.Module):
@@ -147,6 +191,11 @@ class LlamaAttention(nn.Module):
     # at ``positions`` and attention runs masked against the whole cache.
     decode: bool = False
     cache_size: int = 0
+    # page_size > 0 switches the decode cache to the paged pool (shared
+    # (num_pages, page_size, n_kv, head_dim) buffers reached through the
+    # forward's ``block_tables`` argument — see attend_with_paged_cache)
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
     def __call__(
@@ -156,6 +205,7 @@ class LlamaAttention(nn.Module):
         sin: jax.Array,
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
+        block_tables: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -176,7 +226,9 @@ class LlamaAttention(nn.Module):
         # grouped-query attention: K/V keep their n_kv heads all the way into
         # the attention impls (no jnp.repeat — the repeat would materialize
         # n/n_kv× the K/V bytes in HBM and ride the ring at full width)
-        if self.decode:
+        if self.decode and self.page_size > 0:
+            out = attend_with_paged_cache(self, q, k, v, positions, block_tables)
+        elif self.decode:
             out = attend_with_cache(self, q, k, v, positions)
         else:
             out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
@@ -206,7 +258,8 @@ class LlamaMLP(nn.Module):
 class LlamaDecoderLayer(nn.Module):
     """Pre-norm block (parity: modeling_llama.py:243-308).
 
-    Signature is scan-compatible: ``(x, cos, sin, positions, det) -> (x, None)``.
+    Signature is scan-compatible:
+    ``(x, cos, sin, positions, det, block_tables) -> (x, None)``.
     """
 
     config: ModelConfig
@@ -215,15 +268,18 @@ class LlamaDecoderLayer(nn.Module):
     attention_impl: str = "auto"
     decode: bool = False
     cache_size: int = 0
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
         cfg = self.config
         a = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         a = LlamaAttention(
             cfg, self.lora, self.dtype, self.attention_impl,
-            self.decode, self.cache_size, name="self_attn"
-        )(a, cos, sin, positions, deterministic)
+            self.decode, self.cache_size, self.page_size, self.num_pages,
+            name="self_attn"
+        )(a, cos, sin, positions, deterministic, block_tables)
         x = x + a
         m = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="post_attention_layernorm")(x)
         m = LlamaMLP(cfg, self.lora, self.dtype, name="mlp")(m, deterministic)
@@ -236,6 +292,7 @@ def decoder_stack(
     positions: Optional[jax.Array],
     deterministic: bool,
     input_len: int,
+    block_tables: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Shared decoder body: rotary tables + (scanned or unrolled) layers +
     final norm.  Called from inside a parent's @nn.compact, so submodules
@@ -275,24 +332,31 @@ def decoder_stack(
         attention_impl=module.attention_impl,
         decode=decode,
         cache_size=getattr(module, "cache_size", 0),
+        page_size=getattr(module, "page_size", 0),
+        num_pages=getattr(module, "num_pages", 0),
     )
     if module.scan_layers:
         variable_axes = {"params": 0}
         if decode:
             # per-layer KV cache stacks on the same leading "layers" axis
+            # (contiguous per-slot buffers or the shared paged pool alike)
             variable_axes["cache"] = 0
         scanned = nn.scan(
             block,
             variable_axes=variable_axes,
             split_rngs={"params": True, "dropout": True},
-            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast,) * 5,
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, positions, deterministic)
+        x, _ = scanned(**layer_kwargs, name="layers")(
+            x, cos, sin, positions, deterministic, block_tables
+        )
     else:
         for i in range(cfg.num_hidden_layers):
-            x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, positions, deterministic)
+            x, _ = block(**layer_kwargs, name=f"layers_{i}")(
+                x, cos, sin, positions, deterministic, block_tables
+            )
     return RMSNorm(eps=cfg.rms_norm_eps, dtype=module.dtype, name="norm")(x)
 
 
@@ -330,9 +394,13 @@ class LlamaForCausalLM(nn.Module):
     # footprint — the loss upcasts to f32 either way
     logits_dtype: jnp.dtype = jnp.float32
     # inference: decode=True turns on the per-layer KV caches ("cache"
-    # variable collection) of capacity cache_size (see serve/engine.py)
+    # variable collection) of capacity cache_size (see serve/engine.py);
+    # page_size > 0 additionally switches them to the shared paged pool,
+    # reached through the ``block_tables`` call argument
     decode: bool = False
     cache_size: int = 0
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
     def __call__(
@@ -341,9 +409,12 @@ class LlamaForCausalLM(nn.Module):
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
         return_hidden: bool = False,
+        block_tables: Optional[jax.Array] = None,
     ) -> jax.Array:
         x = token_embed(self, input_ids)
-        x = decoder_stack(self, x, positions, deterministic, input_ids.shape[1])
+        x = decoder_stack(
+            self, x, positions, deterministic, input_ids.shape[1], block_tables
+        )
         if return_hidden:
             # chunked-CE path: the caller streams the lm_head projection
             # itself (train/losses.chunked_softmax_ce); init always runs with

@@ -26,7 +26,12 @@ from flax import linen as nn
 
 from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
-from relora_tpu.models.llama import apply_rotary, attend_with_cache, rotary_tables
+from relora_tpu.models.llama import (
+    apply_rotary,
+    attend_with_cache,
+    attend_with_paged_cache,
+    rotary_tables,
+)
 from relora_tpu.models.lora import LoRALinear
 from relora_tpu.ops.attention import dot_product_attention
 
@@ -65,9 +70,13 @@ class NeoXAttention(nn.Module):
     attention_impl: str = "auto"
     decode: bool = False
     cache_size: int = 0
+    # page_size > 0 switches the decode cache to the shared paged pool
+    # (see models/llama.attend_with_paged_cache)
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
         rot = cfg.rotary_dim
@@ -90,7 +99,9 @@ class NeoXAttention(nn.Module):
         q = jnp.concatenate([apply_rotary(q[..., :rot], cos, sin), q[..., rot:]], axis=-1)
         k = jnp.concatenate([apply_rotary(k[..., :rot], cos, sin), k[..., rot:]], axis=-1)
 
-        if self.decode:
+        if self.decode and self.page_size > 0:
+            out = attend_with_paged_cache(self, q, k, v, positions, block_tables)
+        elif self.decode:
             out = attend_with_cache(self, q, k, v, positions)
         else:
             out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
@@ -135,15 +146,18 @@ class NeoXLayer(nn.Module):
     attention_impl: str = "auto"
     decode: bool = False
     cache_size: int = 0
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
         cfg = self.config
         attn_in = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         attn_out = NeoXAttention(
             cfg, self.lora, self.dtype, self.attention_impl,
-            self.decode, self.cache_size, name="attention"
-        )(attn_in, cos, sin, positions, deterministic)
+            self.decode, self.cache_size, self.page_size, self.num_pages,
+            name="attention"
+        )(attn_in, cos, sin, positions, deterministic, block_tables)
         mlp_in = LayerNorm(
             eps=cfg.layer_norm_eps, dtype=self.dtype, name="post_attention_layernorm"
         )(x if cfg.use_parallel_residual else x + attn_out)
@@ -166,9 +180,13 @@ class GPTNeoXForCausalLM(nn.Module):
     attention_impl: str = "auto"
     logits_dtype: jnp.dtype = jnp.float32
     # inference: decode=True turns on the per-layer KV caches ("cache"
-    # variable collection) of capacity cache_size (see serve/engine.py)
+    # variable collection) of capacity cache_size (see serve/engine.py);
+    # page_size > 0 additionally switches them to the shared paged pool,
+    # reached through the ``block_tables`` call argument
     decode: bool = False
     cache_size: int = 0
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
     def __call__(
@@ -177,6 +195,7 @@ class GPTNeoXForCausalLM(nn.Module):
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
         return_hidden: bool = False,
+        block_tables: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         x = nn.Embed(
@@ -217,7 +236,8 @@ class GPTNeoXForCausalLM(nn.Module):
         layer_kwargs = dict(
             config=cfg, lora=self.lora, dtype=self.dtype,
             attention_impl=self.attention_impl, decode=self.decode,
-            cache_size=self.cache_size,
+            cache_size=self.cache_size, page_size=self.page_size,
+            num_pages=self.num_pages,
         )
         if self.scan_layers:
             variable_axes = {"params": 0}
@@ -228,14 +248,18 @@ class GPTNeoXForCausalLM(nn.Module):
                 block,
                 variable_axes=variable_axes,
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast,) * 5,
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = scanned(**layer_kwargs, name="layers")(x, cos, sin, positions, deterministic)
+            x, _ = scanned(**layer_kwargs, name="layers")(
+                x, cos, sin, positions, deterministic, block_tables
+            )
         else:
             for i in range(cfg.num_hidden_layers):
-                x, _ = block(**layer_kwargs, name=f"layers_{i}")(x, cos, sin, positions, deterministic)
+                x, _ = block(**layer_kwargs, name=f"layers_{i}")(
+                    x, cos, sin, positions, deterministic, block_tables
+                )
 
         x = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)
         if return_hidden:

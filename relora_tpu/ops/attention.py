@@ -175,6 +175,50 @@ def cached_attention(
     return out.reshape(B, T, N, H).astype(q.dtype)
 
 
+def gather_kv_pages(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Gather a per-row contiguous K/V view out of a shared page pool.
+
+    ``pool`` is ``(num_pages, page_size, N_kv, H)`` — one buffer shared by
+    every request — and ``block_tables`` is ``(B, W)`` mapping each row's
+    logical page index (``position // page_size``) to a pool page.  Returns
+    ``(B, W * page_size, N_kv, H)`` in logical token order.  Padded table
+    entries point at the null page (paging.NULL_PAGE); whatever garbage
+    lives there is masked off downstream by the ``j <= position``
+    visibility rule, exactly like unwritten tail entries of the contiguous
+    cache.
+    """
+    pages = jnp.take(pool, block_tables, axis=0)  # (B, W, page_size, N_kv, H)
+    B, W, ps = pages.shape[:3]
+    return pages.reshape(B, W * ps, pages.shape[3], pages.shape[4])
+
+
+def paged_cached_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """``cached_attention`` against a paged K/V pool.
+
+    The gather reconstructs each row's logical cache at full table width
+    ``W * page_size`` — with ``W = cache_size / page_size`` that is exactly
+    the contiguous path's contraction length ``C``, and masked entries get
+    softmax probability exactly 0.0 (their f32-min logits underflow the
+    shifted exp), so the result is bitwise-identical to attending the
+    contiguous cache.  That equality is what lets the paged scheduler pin
+    token parity against the contiguous engine.  Width-bucketing the gather
+    to the pages actually used (a read-bandwidth win for short requests in
+    a long-capacity pool) is future work and would trade that bitwise
+    guarantee for an allclose one.
+    """
+    k = gather_kv_pages(pool_k, block_tables)
+    v = gather_kv_pages(pool_v, block_tables)
+    return cached_attention(q, k, v, positions, scale=scale)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

@@ -12,8 +12,14 @@ at the repo root ties them to checkpoint loading.
 
 from relora_tpu.serve.admission import AdmissionController, Draining, QueueFull, ServeMetrics, Ticket
 from relora_tpu.serve.engine import InferenceEngine, build_decode_model, bucket_length
+from relora_tpu.serve.paging import PageAllocator, PrefixCache, pages_needed
 from relora_tpu.serve.sampling import SamplingParams, sample
-from relora_tpu.serve.scheduler import Completion, ContinuousBatchingScheduler, Request
+from relora_tpu.serve.scheduler import (
+    Completion,
+    ContinuousBatchingScheduler,
+    PagedContinuousBatchingScheduler,
+    Request,
+)
 from relora_tpu.serve.server import GenerateServer, run_server
 
 __all__ = [
@@ -23,6 +29,9 @@ __all__ = [
     "Draining",
     "GenerateServer",
     "InferenceEngine",
+    "PageAllocator",
+    "PagedContinuousBatchingScheduler",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "SamplingParams",
@@ -30,6 +39,7 @@ __all__ = [
     "Ticket",
     "bucket_length",
     "build_decode_model",
+    "pages_needed",
     "run_server",
     "sample",
 ]

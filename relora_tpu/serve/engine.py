@@ -21,6 +21,15 @@ cached_attention).  This module wraps that into an inference engine:
 Prompt lengths are bucketed to powers of two (``bucket_length``) to bound the
 number of prefill compilations.
 
+Paged mode (``page_size``/``num_pages`` set): the contiguous per-slot cache is
+replaced by one shared page pool (serve/paging.py) and two entry points —
+``prefill_chunk(ids, start, pool, block_table)`` writes one fixed-size prompt
+chunk straight into the pool through the request's block table (no insert
+copy), and ``decode_paged(pool, token, pos, block_tables)`` decodes every slot
+through its table.  Both compile exactly once: prompt length appears in no
+compiled shape, and cache HBM scales with ``num_pages``, not
+``max_batch × cache_size``.
+
 Shardings: with a mesh, params shard per the model's logical annotations
 (parallel/mesh.py LOGICAL_RULES) and cache buffers shard their batch axis over
 ``data``×``fsdp`` — K/V heads stay replicated like the ``kv`` logical axis.
@@ -72,6 +81,8 @@ def build_decode_model(
     scan_layers: bool = True,
     attention_impl: str = "auto",
     lora: Optional[LoraSpec] = None,
+    page_size: int = 0,
+    num_pages: int = 0,
 ):
     """The serving twin of train.trainer.build_model: same family dispatch,
     decode cache enabled, no remat.  ``lora=None`` (the default) serves a
@@ -98,6 +109,8 @@ def build_decode_model(
         logits_dtype=jnp.float32,
         decode=True,
         cache_size=cache_size,
+        page_size=page_size,
+        num_pages=num_pages,
     )
     if model_cfg.family == "llama":
         from relora_tpu.models.llama import LlamaForCausalLM
@@ -131,12 +144,42 @@ class InferenceEngine:
         mesh: Optional[Mesh] = None,
         lora: Optional[LoraSpec] = None,
         compile_watcher: Optional[CompileWatcher] = None,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        chunk_size: int = 64,
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
         self.config = model_cfg
         self.cache_size = cache_size
         self.mesh = mesh
+        # paged mode: page_size enables the block-granular pool (see
+        # serve/paging.py); cache_size stays the per-request capacity bound
+        # (validate_request semantics unchanged) and must page-align so the
+        # gathered table width W*page_size equals the contiguous contraction
+        # length C — the bitwise token-parity invariant
+        self.paged = page_size is not None
+        if self.paged:
+            if page_size < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            if cache_size % page_size:
+                raise ValueError(
+                    f"cache_size ({cache_size}) must be a multiple of "
+                    f"page_size ({page_size}) for paged decode"
+                )
+            self.block_table_width = cache_size // page_size
+            if num_pages is None:
+                raise ValueError("paged decode requires num_pages")
+            if num_pages < self.block_table_width + 1:
+                raise ValueError(
+                    f"num_pages ({num_pages}) cannot hold one max-size request: "
+                    f"need >= {self.block_table_width} + 1 (page 0 is the null page)"
+                )
+            if chunk_size < 1:
+                raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        self.page_size = page_size or 0
+        self.num_pages = num_pages or 0
+        self.chunk_size = min(chunk_size, cache_size)
         self.model = build_decode_model(
             model_cfg,
             cache_size=cache_size,
@@ -186,6 +229,49 @@ class InferenceEngine:
         self._decode = cw.wrap("decode", jax.jit(decode_fn, donate_argnums=(1,)))
         self._insert = cw.wrap("insert", jax.jit(insert_fn, donate_argnums=(0,)))
         self._sample = jax.jit(sample, static_argnames=("top_k",))
+
+        if self.paged:
+            # a second model instance over the same params: cache variables
+            # are the shared (num_pages, page_size, n_kv, head_dim) pool and
+            # every forward takes a block table.  There is no insert —
+            # prefill chunks write straight into the pool through the table.
+            self.paged_model = build_decode_model(
+                model_cfg,
+                cache_size=cache_size,
+                dtype=dtype,
+                scan_layers=scan_layers,
+                attention_impl=attention_impl,
+                lora=lora,
+                page_size=self.page_size,
+                num_pages=self.num_pages,
+            )
+
+            def prefill_chunk_fn(p, ids, positions, pool, block_tables):
+                logits, variables = self.paged_model.apply(
+                    {"params": p, "cache": pool},
+                    ids,
+                    positions=positions,
+                    block_tables=block_tables,
+                    mutable=["cache"],
+                )
+                return logits, variables["cache"]
+
+            def decode_paged_fn(p, pool, token, pos, block_tables):
+                logits, variables = self.paged_model.apply(
+                    {"params": p, "cache": pool},
+                    token,
+                    positions=pos,
+                    block_tables=block_tables,
+                    mutable=["cache"],
+                )
+                return logits[:, -1, :], variables["cache"]
+
+            self._prefill_chunk = cw.wrap(
+                "prefill_chunk", jax.jit(prefill_chunk_fn, donate_argnums=(3,))
+            )
+            self._decode_paged = cw.wrap(
+                "decode_paged", jax.jit(decode_paged_fn, donate_argnums=(1,))
+            )
 
     # -- cache construction --------------------------------------------------
 
@@ -253,18 +339,138 @@ class InferenceEngine:
         ``dcache`` is donated; ``slot`` is traced (no retrace per slot)."""
         return self._insert(dcache, pcache, jnp.asarray(slot, jnp.int32))
 
-    def warmup(self, batch: int, *, prompt_buckets: Sequence[int] = (16,)) -> dict:
-        """Compile the serving step functions before traffic arrives: one
-        prefill per prompt bucket, one insert, one decode at ``batch`` rows.
+    # -- paged step functions (page_size set at construction) ----------------
+
+    def _require_paged(self):
+        if not self.paged:
+            raise ValueError("engine was built without page_size: no paged entry points")
+
+    def pool_shapes(self) -> PyTree:
+        """Abstract tree of the shared K/V page pool — per-layer leaves of
+        shape (num_pages, page_size, kv_heads, head_dim) (a leading layers
+        axis when scanned).  Its byte size scales with ``num_pages``, not
+        ``max_batch × cache_size`` — the paged memory win, visible in
+        ``memory_plans()``'s pytree breakdown."""
+        self._require_paged()
+        ids = jnp.zeros((1, 1), jnp.int32)
+        bt = jnp.zeros((1, self.block_table_width), jnp.int32)
+        variables = jax.eval_shape(
+            lambda: self.paged_model.init(jax.random.PRNGKey(0), ids, block_tables=bt)
+        )
+        return variables["cache"]
+
+    def init_pool(self) -> PyTree:
+        """Concrete zero page pool.  Replicated under a mesh (the pool has
+        no batch axis to shard; K/V heads stay replicated like the ``kv``
+        logical axis)."""
+        self._require_paged()
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self.pool_shapes()
+        )
+
+    def prefill_chunk(
+        self, ids: jax.Array, start: int, pool: PyTree, block_table
+    ) -> Tuple[jax.Array, PyTree]:
+        """Prefill one fixed-size chunk of a single prompt: ``ids`` is
+        ``(1, chunk_size)`` (right-padded past the prompt), written at
+        absolute positions ``start .. start+chunk_size-1`` through
+        ``block_table`` ``(1, W)``.  Returns full chunk logits
+        ``(1, chunk_size, V)`` and the updated pool (input pool donated).
+        One compiled shape total — chunking is what keeps a long prompt off
+        the decode loop's critical path for more than one chunk."""
+        self._require_paged()
+        B, T = ids.shape
+        positions = jnp.asarray(start, jnp.int32) + jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
+        )
+        return self._prefill_chunk(
+            self.params,
+            jnp.asarray(ids),
+            positions,
+            pool,
+            jnp.asarray(block_table, jnp.int32),
+        )
+
+    def decode_paged(
+        self, pool: PyTree, token: jax.Array, pos: jax.Array, block_tables
+    ) -> Tuple[jax.Array, PyTree]:
+        """One paged decode step: ``token``/``pos`` are ``(B, 1)``,
+        ``block_tables`` is ``(B, W)``.  Rows without an active decoding
+        request must carry all-null tables so their garbage write lands in
+        the null page, never in a page another request is prefilling into.
+        Returns logits ``(B, V)`` and the updated pool (input donated)."""
+        self._require_paged()
+        return self._decode_paged(
+            self.params,
+            pool,
+            jnp.asarray(token),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+        )
+
+    def default_prompt_buckets(self) -> Tuple[int, ...]:
+        """Every prefill shape a prompt can actually land in: powers of two
+        from the bucket minimum up, capped at ``cache_size`` (which is
+        itself a bucket when it is not a power of two).  Warming all of
+        them means the first long prompt is never a steady-state retrace."""
+        buckets: List[int] = []
+        t = bucket_length(1)
+        while t < self.cache_size:
+            buckets.append(t)
+            t *= 2
+        buckets.append(self.cache_size)
+        return tuple(buckets)
+
+    def warmup(self, batch: int, *, prompt_buckets: Optional[Sequence[int]] = None) -> dict:
+        """Compile the serving step functions before traffic arrives.
         An online server calls this at startup so the first real request
         pays queueing latency, not XLA compilation.
 
-        Returns a report of what was compiled — the buckets and batch shapes
-        plus per-compile durations — so operators can log it and compile
-        telemetry can tell these expected compiles apart from steady-state
-        retraces (a prompt landing in an un-warmed bucket after this)."""
+        Contiguous engine: one prefill per prompt bucket — defaulting to
+        *every* power-of-two bucket up to ``cache_size`` (a prompt can land
+        in any of them; warming only the smallest made the first long
+        prompt a steady-state retrace) — plus one insert and one decode at
+        ``batch`` rows.  Paged engine: exactly two shapes total, the
+        ``(1, chunk_size)`` prefill chunk and the ``(batch, 1)`` paged
+        decode — prompt length no longer appears in any compiled shape.
+
+        Returns a report of what was compiled — shapes plus per-compile
+        durations — so operators can log it and compile telemetry can tell
+        these expected compiles apart from steady-state retraces."""
         cw = self.compile_watcher
         n_before = len(cw.compile_events())
+        if self.paged:
+            with cw.expected_compiles("warmup"):
+                pool = self.init_pool()
+                _, pool = self.prefill_chunk(
+                    jnp.zeros((1, self.chunk_size), jnp.int32),
+                    0,
+                    pool,
+                    jnp.zeros((1, self.block_table_width), jnp.int32),
+                )
+                logits, pool = self.decode_paged(
+                    pool,
+                    jnp.zeros((batch, 1), jnp.int32),
+                    jnp.zeros((batch, 1), jnp.int32),
+                    jnp.zeros((batch, self.block_table_width), jnp.int32),
+                )
+                jax.block_until_ready(logits)
+            events = cw.compile_events()[n_before:]
+            return {
+                "batch": batch,
+                "prompt_buckets": [],
+                "shapes": {
+                    "prefill_chunk": [1, self.chunk_size],
+                    "decode_paged": [batch, 1],
+                },
+                "n_compiles": len(events),
+                "compiles": [
+                    {"fn": ev.fn, "duration_s": round(ev.duration_s, 4), "reason": ev.reason}
+                    for ev in events
+                ],
+            }
+        if prompt_buckets is None:
+            prompt_buckets = self.default_prompt_buckets()
         buckets: List[int] = []
         with cw.expected_compiles("warmup"):
             pcache = None
@@ -296,23 +502,52 @@ class InferenceEngine:
             ],
         }
 
-    def memory_plans(self, batch: int, *, prompt_buckets: Sequence[int] = (16,)) -> dict:
+    def memory_plans(self, batch: int, *, prompt_buckets: Optional[Sequence[int]] = None) -> dict:
         """Static HBM plans for every jitted serving entry point (per-bucket
-        prefill, insert, decode at ``batch`` rows) plus the per-pytree
-        breakdown of what stays resident (params, KV cache).
+        prefill, insert, decode at ``batch`` rows — or the chunk/decode pair
+        when paged) plus the per-pytree breakdown of what stays resident
+        (params, KV cache).  On a paged engine the ``kv_cache`` entry is the
+        shared page pool, whose bytes scale with ``num_pages`` rather than
+        ``max_batch × cache_size``.
 
         Uses AOT lower+compile, which does NOT warm the traced-call cache —
         each plan pays a real compile (tagged expected), so call this at
         startup or in reports, not per request.  Off-accelerator the XLA
         numbers describe host buffers, but the relative breakdown holds."""
-        plans: dict = {
+        i32 = jnp.int32
+        if self.paged:
+            pool = self.pool_shapes()
+            plans: dict = {
+                "pytree": obs_memory.pytree_breakdown(
+                    {"params": self.params, "kv_cache": pool}
+                )
+            }
+            plans["prefill_chunk"] = obs_memory.plan_for(
+                self._prefill_chunk,
+                self.params,
+                jax.ShapeDtypeStruct((1, self.chunk_size), i32),
+                jax.ShapeDtypeStruct((1, self.chunk_size), i32),
+                pool,
+                jax.ShapeDtypeStruct((1, self.block_table_width), i32),
+            )
+            plans["decode_paged"] = obs_memory.plan_for(
+                self._decode_paged,
+                self.params,
+                pool,
+                jax.ShapeDtypeStruct((batch, 1), i32),
+                jax.ShapeDtypeStruct((batch, 1), i32),
+                jax.ShapeDtypeStruct((batch, self.block_table_width), i32),
+            )
+            return plans
+        if prompt_buckets is None:
+            prompt_buckets = self.default_prompt_buckets()
+        plans = {
             "pytree": obs_memory.pytree_breakdown(
                 {"params": self.params, "kv_cache": self.cache_shapes(batch)}
             )
         }
         dcache = self.cache_shapes(batch)
         pcache1 = self.cache_shapes(1)
-        i32 = jnp.int32
         # AOT plans bypass __call__, so the watcher never sees them — no
         # expected_compiles block needed
         for bucket in prompt_buckets:

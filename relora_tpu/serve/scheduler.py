@@ -57,6 +57,7 @@ import numpy as np
 
 from relora_tpu.obs.tracer import NoopTracer
 from relora_tpu.serve.engine import InferenceEngine, bucket_length
+from relora_tpu.serve.paging import PageAllocator, PrefixCache, pages_needed
 from relora_tpu.serve.sampling import SamplingParams
 from relora_tpu.utils.logging import MetricsLogger, get_logger
 
@@ -517,3 +518,301 @@ class ContinuousBatchingScheduler:
             logger.warning(
                 f"request {completion.uid}: finish callback failed: {e!r}"
             )
+
+
+@dataclasses.dataclass
+class _PagedSlot(_Slot):
+    pages: List[int] = dataclasses.field(default_factory=list)  # logical order
+    shared_pages: int = 0  # leading pages borrowed from the prefix cache
+    prefill_progress: int = 0  # prompt tokens already written to the pool
+    decoding: bool = False  # first token sampled; joins the decode batch
+    seq: int = 0  # admission order; chunk scheduling is oldest-first
+
+
+class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
+    """Continuous batching over the paged engine: budgeted rounds instead of
+    prefill-on-admission.
+
+    Each ``step()`` spends its budget as: expire deadlines, admit pending
+    requests (page allocation + prefix-cache lookup only — cheap host work),
+    run **at most one prefill chunk** for the oldest still-prefilling slot,
+    then one paged decode over every decoding slot.  A long prompt therefore
+    never stalls in-flight streams for more than one ``chunk_size`` forward —
+    the contiguous scheduler's ``serve/prefill_stall_share`` is exactly the
+    cost this removes.
+
+    Admission is all-or-nothing on pages (worst case
+    ``ceil((prompt + max_new_tokens) / page_size)``): when the pool is
+    exhausted the queue head *stays queued* (FIFO — later requests do not
+    jump it) and is retried next round after retired requests or evicted
+    prefix entries free pages.  Contrast with the HTTP front-end's 429 path,
+    which only bounds the *queue*; allocator pressure never rejects.
+
+    Sampling keys stay ``(uid, token_index)`` — the same stream as the
+    contiguous scheduler — and the paged attention math is bitwise-identical
+    to the contiguous path (ops/attention.paged_cached_attention), so a
+    drain through this scheduler is token-identical to the contiguous one
+    for the same request stream (pinned by tests/test_paging.py).
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        prefix_cache: bool = True,
+        prefix_cache_entries: int = 256,
+        **kwargs,
+    ):
+        super().__init__(engine, **kwargs)
+        if not getattr(engine, "paged", False):
+            raise ValueError(
+                "PagedContinuousBatchingScheduler needs an engine built with "
+                "page_size/num_pages (got a contiguous InferenceEngine)"
+            )
+        self.allocator = PageAllocator(engine.num_pages, engine.page_size)
+        self.prefix_cache = (
+            PrefixCache(self.allocator, max_entries=prefix_cache_entries)
+            if prefix_cache
+            else None
+        )
+        self._pool = None  # allocated on first admission, then persistent
+        # per-row decode block tables: NULL rows for free / still-prefilling
+        # slots, so their garbage decode write lands in the null page
+        self._tables = np.zeros((self.max_batch, engine.block_table_width), np.int32)
+        self._admit_seq = 0  # admission order, drives chunk scheduling (FIFO)
+        self._pad_tokens = 0  # chunk padding written, cumulative
+        self._prefill_tokens = 0  # real prompt tokens written, cumulative
+
+    # -- admission ------------------------------------------------------------
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            self._pool = self.engine.init_pool()
+        return self._pool
+
+    def _admit_pass(self, finished: List[Completion]) -> None:
+        """Fill free slots from the queue head: prefix lookup + page
+        allocation only (no device work — the prefill happens one chunk per
+        step).  Allocation failure leaves the head queued and stops."""
+        while self._pending:
+            slot_idx = next(
+                (i for i in range(self.max_batch) if self._slots[i] is None), None
+            )
+            if slot_idx is None:
+                return
+            req = self._pending[0]
+            deadline = self._deadlines.get(req.uid)
+            if deadline is not None and time.monotonic() >= deadline:
+                self._pending.popleft()
+                finished.append(self._finalize_unadmitted(req, "timeout"))
+                continue
+            need = pages_needed(
+                len(req.prompt) + req.max_new_tokens, self.engine.page_size
+            )
+            shared_pages: List[int] = []
+            shared_tokens = 0
+            if self.prefix_cache is not None:
+                shared_pages, shared_tokens = self.prefix_cache.lookup(req.prompt)
+            fresh = self.allocator.alloc(need - len(shared_pages))
+            if fresh is None and self.prefix_cache is not None:
+                # under pressure: drop idle prefix entries (LRU) and retry —
+                # entries shared with live requests survive via refcounts
+                self.prefix_cache.evict(need - len(shared_pages))
+                fresh = self.allocator.alloc(need - len(shared_pages))
+            if fresh is None:
+                # allocator exhausted: stay queued rather than reject; pages
+                # free as decoding requests retire (docs/operations.md)
+                if shared_pages:
+                    self.allocator.decref(shared_pages)
+                return
+            self._pending.popleft()
+            t_admit = time.monotonic()
+            self._slots[slot_idx] = _PagedSlot(
+                request=req,
+                pos=0,
+                tokens=[],
+                t_admit=t_admit,
+                t_first=t_admit,  # overwritten when the first token lands
+                deadline=deadline,
+                span=None,  # decode span opens at first token
+                pages=shared_pages + fresh,
+                shared_pages=len(shared_pages),
+                prefill_progress=shared_tokens,
+                seq=self._admit_seq,
+            )
+            self._admit_seq += 1
+            # decode row stays NULL until this slot starts decoding
+            self._tokens[slot_idx] = 0
+            self._positions[slot_idx] = 0
+            self._tables[slot_idx, :] = 0
+
+    # -- prefill (one chunk per round) ----------------------------------------
+
+    def _prefill_pass(self, finished: List[Completion]) -> None:
+        """Run one prefill chunk for the oldest still-prefilling slot; when
+        it completes the prompt, sample the first token (key (uid, 0) — the
+        same stream as the contiguous path) and arm the slot for decode."""
+        prefilling = [
+            (s.seq, i)
+            for i, s in enumerate(self._slots)
+            if s is not None and not s.decoding
+        ]
+        if not prefilling:
+            return
+        slot_idx = min(prefilling)[1]  # oldest admission first (FIFO)
+        slot = self._slots[slot_idx]
+        req = slot.request
+        L = len(req.prompt)
+        chunk = self.engine.chunk_size
+        start = slot.prefill_progress
+        n_real = min(chunk, L - start)
+        ids = np.zeros((1, chunk), np.int32)
+        ids[0, :n_real] = list(req.prompt[start : start + n_real])
+        table = np.zeros((1, self.engine.block_table_width), np.int32)
+        table[0, : len(slot.pages)] = slot.pages
+        self._pad_tokens += chunk - n_real
+        self._prefill_tokens += n_real
+        tid = self._trace_ids.get(req.uid)
+        first_id = None
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "prefill_chunk", trace_id=tid, uid=req.uid, start=start, chunk=chunk
+        ):
+            logits, self._pool = self.engine.prefill_chunk(
+                jnp.asarray(ids), start, self._ensure_pool(), table
+            )
+            slot.prefill_progress = start + n_real
+            if slot.prefill_progress >= L:
+                first = self.engine._sample(
+                    logits[:, L - 1 - start, :],
+                    self._request_key(req, 0),
+                    temperature=req.temperature,
+                    top_k=self.top_k,
+                    top_p=req.top_p,
+                )
+                first_id = int(np.asarray(first)[0])
+        self._observe("prefill_seconds", time.monotonic() - t0)
+        if first_id is None:
+            return  # more chunks to go; decode proceeds this round regardless
+        if self.prefix_cache is not None:
+            # only pages fully covered by prompt tokens register — the
+            # donor's decode writes (positions >= L) never touch them
+            self.prefix_cache.register(list(req.prompt), slot.pages)
+        slot.decoding = True
+        slot.tokens = [first_id]
+        slot.pos = L
+        slot.t_first = time.monotonic()
+        slot.span = self.tracer.start_span("decode", trace_id=tid, uid=req.uid)
+        self._tokens[slot_idx] = first_id
+        self._positions[slot_idx] = L
+        self._tables[slot_idx, : len(slot.pages)] = slot.pages
+        self._emit_token(req.uid, first_id, 0)
+        self._finish_if_done(slot_idx, finished)
+
+    # -- the budgeted round ----------------------------------------------------
+
+    def step(self) -> List[Completion]:
+        """One budgeted round: expire deadlines, admit (page accounting
+        only), at most one prefill chunk, then one paged decode over every
+        decoding slot.  Returns the requests that finished during it."""
+        finished: List[Completion] = []
+        t_step = time.monotonic()
+        self._expire_deadlines(finished)
+        self._admit_pass(finished)
+        self._prefill_pass(finished)
+        admit_s = time.monotonic() - t_step
+        decoding = [
+            s is not None and s.decoding for s in self._slots
+        ]
+        n_decoding = sum(decoding)
+        if n_decoding == 0:
+            return finished  # pure-prefill round (or idle)
+
+        t_decode = time.monotonic()
+        with self.tracer.span(
+            "decode_step", step=self._step_count, active_slots=n_decoding
+        ):
+            logits, self._pool = self.engine.decode_paged(
+                self._ensure_pool(),
+                jnp.asarray(self._tokens)[:, None],
+                jnp.asarray(self._positions)[:, None],
+                self._tables,
+            )
+            self._step_count += 1
+            masked = [
+                s if (s is not None and s.decoding) else None for s in self._slots
+            ]
+            next_tokens = self._sample_rows(logits, masked).tolist()
+        decode_s = time.monotonic() - t_decode
+        self._observe("decode_step_seconds", decode_s)
+        batch_fill = n_decoding / self.max_batch
+        stall_share = admit_s / max(admit_s + decode_s, 1e-9)
+        pad_share = self._pad_tokens / max(self._pad_tokens + self._prefill_tokens, 1)
+        hit_rate = self.prefix_cache.hit_rate if self.prefix_cache is not None else 0.0
+        if self.obs_registry is not None:
+            self.obs_registry.set_gauge("batch_fill", batch_fill)
+            self.obs_registry.set_gauge("prefill_stall_share", stall_share)
+            self.obs_registry.set_gauge("kv_pages_used", self.allocator.used_pages)
+            self.obs_registry.set_gauge("kv_pages_free", self.allocator.free_pages)
+            self.obs_registry.set_gauge("prefix_cache_hit_rate", hit_rate)
+            self.obs_registry.set_gauge("prefill_pad_share", pad_share)
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding:
+                continue
+            tok = next_tokens[slot_idx]
+            slot.tokens.append(tok)
+            slot.pos += 1
+            self._tokens[slot_idx] = tok
+            self._positions[slot_idx] = slot.pos
+            self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
+            self._finish_if_done(slot_idx, finished)
+        if self.metrics is not None:
+            watcher = getattr(self.engine, "compile_watcher", None)
+            self.metrics.log(
+                {
+                    "serve/decode_step": self._step_count,
+                    "serve/queue_depth": len(self._pending),
+                    "serve/active_slots": self.active_slots,
+                    "serve/batch_fill": round(batch_fill, 4),
+                    "serve/prefill_stall_s": round(admit_s, 6),
+                    "serve/prefill_stall_share": round(stall_share, 4),
+                    "serve/kv_pages_used": self.allocator.used_pages,
+                    "serve/kv_pages_free": self.allocator.free_pages,
+                    "serve/prefix_cache_hit_rate": round(hit_rate, 4),
+                    "serve/prefill_pad_share": round(pad_share, 4),
+                    "compile/steady_state_retraces": (
+                        watcher.steady_state_retraces if watcher is not None else 0
+                    ),
+                }
+            )
+        return finished
+
+    # -- retirement (page bookkeeping) ----------------------------------------
+
+    def _retire(self, slot_idx: int, reason: str) -> Completion:
+        slot = self._slots[slot_idx]
+        completion = super()._retire(slot_idx, reason)
+        if slot.pages:
+            # one decref per page: fresh pages drop their alloc ref, shared
+            # pages drop this request's lookup ref (the prefix cache's own
+            # refs keep registered pages alive for the next hit)
+            self.allocator.decref(slot.pages)
+            slot.pages = []
+        self._tables[slot_idx, :] = 0
+        self._tokens[slot_idx] = 0
+        self._positions[slot_idx] = 0
+        return completion
+
+    def paging_stats(self) -> Dict[str, Any]:
+        """Point-in-time pool/prefix counters for /healthz and load tools."""
+        stats: Dict[str, Any] = {
+            "kv_pages_used": self.allocator.used_pages,
+            "kv_pages_free": self.allocator.free_pages,
+            "kv_pages_peak": self.allocator.peak_used,
+            "prefill_pad_share": round(
+                self._pad_tokens / max(self._pad_tokens + self._prefill_tokens, 1), 4
+            ),
+        }
+        if self.prefix_cache is not None:
+            stats["prefix_cache"] = self.prefix_cache.stats()
+        return stats

@@ -383,18 +383,20 @@ class GenerateServer:
     async def _handle_healthz(self, writer: asyncio.StreamWriter) -> None:
         draining = self.admission.draining
         status = 503 if draining else 200
-        await _respond_json(
-            writer,
-            status,
-            {
-                "status": "draining" if draining else "ok",
-                "active_slots": self.scheduler.active_slots,
-                "queue_depth": self.admission.depth() + self.scheduler.queue_depth,
-                "max_batch": self.scheduler.max_batch,
-                "max_queue": self.admission.max_queue,
-                "uptime_s": round(time.monotonic() - self._t_start, 3),
-            },
-        )
+        payload = {
+            "status": "draining" if draining else "ok",
+            "active_slots": self.scheduler.active_slots,
+            "queue_depth": self.admission.depth() + self.scheduler.queue_depth,
+            "max_batch": self.scheduler.max_batch,
+            "max_queue": self.admission.max_queue,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+        }
+        # paged scheduler: pool pressure for the allocator-exhaustion triage
+        # flow (docs/operations.md) — queued-but-healthy vs queued-and-starved
+        paging_stats = getattr(self.scheduler, "paging_stats", None)
+        if paging_stats is not None:
+            payload["paging"] = paging_stats()
+        await _respond_json(writer, status, payload)
 
     async def _handle_generate(
         self,

@@ -152,6 +152,50 @@ EOF
 kill -TERM "$SERVER_PID"
 wait "$SERVER_PID"   # exit 0 = SIGTERM drain completed cleanly
 
+echo "=== 9b. paged KV server (chunked prefill, long+short prompt mix) ==="
+rm -f "$WORK/paged_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/paged_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 6 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --run-dir "$WORK/paged_run" &
+PAGED_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/paged_port" ] && break; sleep 0.2; done
+[ -s "$WORK/paged_port" ] || { echo "paged server never wrote its port"; kill "$PAGED_PID"; exit 1; }
+python - "$(cat "$WORK/paged_port")" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+assert health["status"] == "ok" and "paging" in health, health
+assert health["paging"]["kv_pages_used"] == 0, health["paging"]
+
+def generate(prompt):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 6}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+    final = json.loads(events[-2])
+    assert final["finish_reason"] == "length" and len(final["tokens"]) == 6, final
+    return final["tokens"]
+
+# long prompt (spans several chunks + pages) and short prompts interleaved
+long_prompt = [(i % 100) + 1 for i in range(40)]
+first = generate(long_prompt)
+generate([1, 2, 3])
+# identical long prompt again: served through the prefix cache, same tokens
+assert generate(long_prompt) == first, "prefix-cache replay diverged"
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+paging = health["paging"]
+assert paging["kv_pages_used"] > 0, paging  # prefix entries hold pages
+assert paging["prefix_cache"]["hits"] >= 1, paging
+print("paged HTTP OK:", first, "| paging:", paging)
+EOF
+kill -TERM "$PAGED_PID"
+wait "$PAGED_PID"
+grep -q "serve/kv_pages_used" "$WORK/paged_run/metrics.jsonl"
+grep -q "serve/prefix_cache_hit_rate" "$WORK/paged_run/metrics.jsonl"
+
 echo "=== 10. traced run + SIGTERM flight dump (obs subsystem) ==="
 # fault injection fires a real SIGTERM at update 4; the PreemptionGuard
 # handler dumps the span flight recorder before the emergency checkpoint

@@ -65,6 +65,27 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-queue", type=int, default=64, help="server: max waiting requests before 429")
     p.add_argument("--port-file", default=None, help="server: write the bound port here once listening")
     p.add_argument("--no-warmup", action="store_true", help="server: skip compile warmup at startup")
+    p.add_argument(
+        "--paged",
+        action="store_true",
+        help="block-granular paged KV cache: chunked prefill interleaved with "
+        "decode, page-pool admission (queue on exhaustion, never reject), "
+        "prefix caching (docs/serving.md)",
+    )
+    p.add_argument("--page-size", type=int, default=16, help="paged: tokens per KV page")
+    p.add_argument(
+        "--num-pages",
+        type=int,
+        default=0,
+        help="paged: pool capacity in pages (0 = max_batch full-length "
+        "requests plus the null page)",
+    )
+    p.add_argument("--chunk-size", type=int, default=64, help="paged: prefill chunk length")
+    p.add_argument(
+        "--no-prefix-cache",
+        action="store_true",
+        help="paged: disable shared-prefix page reuse",
+    )
     p.add_argument("--no-scan", action="store_true", help="checkpoint was trained with scan_layers=false")
     p.add_argument(
         "--no-merge",
@@ -143,6 +164,17 @@ def main(argv=None) -> int:
 
     cache_size = args.cache_size or model_cfg.max_sequence_length
     eos_id = args.eos_id if args.eos_id is not None else model_cfg.eos_token_id
+    paged_kwargs = {}
+    if args.paged:
+        # default pool: every slot at full length simultaneously, + null page
+        num_pages = args.num_pages or (
+            args.max_batch * (cache_size // args.page_size) + 1
+        )
+        paged_kwargs = dict(
+            page_size=args.page_size,
+            num_pages=num_pages,
+            chunk_size=args.chunk_size,
+        )
     engine = InferenceEngine(
         model_cfg,
         params,
@@ -150,11 +182,30 @@ def main(argv=None) -> int:
         dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
         scan_layers=not args.no_scan,
         lora=lora_spec,
+        **paged_kwargs,
     )
     key = jax.random.PRNGKey(args.seed)
 
+    def build_scheduler(metrics):
+        from relora_tpu.serve.scheduler import (
+            ContinuousBatchingScheduler,
+            PagedContinuousBatchingScheduler,
+        )
+
+        common = dict(
+            max_batch=args.max_batch,
+            eos_id=eos_id,
+            top_k=args.top_k,
+            metrics=metrics,
+            key=key,
+        )
+        if args.paged:
+            return PagedContinuousBatchingScheduler(
+                engine, prefix_cache=not args.no_prefix_cache, **common
+            )
+        return ContinuousBatchingScheduler(engine, **common)
+
     if args.port is not None:
-        from relora_tpu.serve.scheduler import ContinuousBatchingScheduler
         from relora_tpu.serve.server import run_server
         from relora_tpu.utils.logging import MetricsLogger
 
@@ -177,14 +228,7 @@ def main(argv=None) -> int:
                     prompt_buckets=report["prompt_buckets"],
                     n_compiles=report["n_compiles"],
                 )
-        scheduler = ContinuousBatchingScheduler(
-            engine,
-            max_batch=args.max_batch,
-            eos_id=eos_id,
-            top_k=args.top_k,
-            metrics=metrics,
-            key=key,
-        )
+        scheduler = build_scheduler(metrics)
 
         def ready(server):
             if args.port_file:
@@ -224,7 +268,7 @@ def main(argv=None) -> int:
     if args.input_file is None:
         raise SystemExit("nothing to do: pass --prompt or --input-file")
 
-    from relora_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+    from relora_tpu.serve.scheduler import Request
     from relora_tpu.utils.logging import MetricsLogger
 
     fh = sys.stdin if args.input_file == "-" else open(args.input_file)
@@ -247,14 +291,7 @@ def main(argv=None) -> int:
         raise SystemExit(f"no requests in {args.input_file}")
 
     metrics = MetricsLogger(run_dir=args.run_dir) if args.run_dir else None
-    scheduler = ContinuousBatchingScheduler(
-        engine,
-        max_batch=args.max_batch,
-        eos_id=eos_id,
-        top_k=args.top_k,
-        metrics=metrics,
-        key=key,
-    )
+    scheduler = build_scheduler(metrics)
     completions = scheduler.run(requests)
     for uid in sorted(completions):
         print(_decode_tokens(completions[uid].tokens, tokenizer))

@@ -1,0 +1,418 @@
+"""Paged KV cache tests: allocator/prefix-cache bookkeeping, chunked-prefill
+parity, and the acceptance oracle for the paged scheduler — a drain through
+``PagedContinuousBatchingScheduler`` must be **token-identical** to the
+contiguous ``ContinuousBatchingScheduler`` for the same request stream
+(greedy and sampled, staggered admissions, early EOS), because the paged
+attention gather reconstructs the contiguous contraction exactly and
+sampling keys stay ``(uid, token_index)``.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.models.params_util import init_params
+from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+from relora_tpu.serve.paging import NULL_PAGE, PageAllocator, PrefixCache, pages_needed
+from relora_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    PagedContinuousBatchingScheduler,
+    Request,
+)
+from relora_tpu.utils.logging import MetricsLogger
+
+pytestmark = pytest.mark.serve
+
+TINY_LLAMA = ModelConfig(
+    family="llama",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+)
+TINY_NEOX = ModelConfig(
+    family="neox",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+    rotary_pct=0.25,
+)
+
+
+# -- host-side bookkeeping ----------------------------------------------------
+
+
+def test_pages_needed():
+    assert pages_needed(1, 8) == 1
+    assert pages_needed(8, 8) == 1
+    assert pages_needed(9, 8) == 2
+    assert pages_needed(0, 8) == 0
+
+
+class TestPageAllocator:
+    def test_null_page_reserved(self):
+        alloc = PageAllocator(4, 8)
+        pages = alloc.alloc(3)
+        assert NULL_PAGE not in pages
+        assert sorted(pages) == [1, 2, 3]
+
+    def test_alloc_all_or_nothing(self):
+        alloc = PageAllocator(5, 8)  # 4 usable pages
+        assert alloc.alloc(3) is not None
+        free_before = alloc.free_pages
+        assert alloc.alloc(2) is None  # only 1 free: nothing allocated
+        assert alloc.free_pages == free_before
+        assert alloc.alloc(1) is not None
+        assert alloc.free_pages == 0
+
+    def test_decref_frees_incref_shares(self):
+        alloc = PageAllocator(4, 8)
+        [a, b] = alloc.alloc(2)
+        alloc.incref([a])
+        assert alloc.refcount(a) == 2
+        assert alloc.decref([a, b]) == 1  # only b reached zero
+        assert alloc.used_pages == 1
+        assert alloc.decref([a]) == 1
+        assert alloc.used_pages == 0
+
+    def test_double_free_raises(self):
+        alloc = PageAllocator(4, 8)
+        [a] = alloc.alloc(1)
+        alloc.decref([a])
+        with pytest.raises(ValueError, match="double free"):
+            alloc.decref([a])
+        with pytest.raises(ValueError, match="invalid page"):
+            alloc.decref([NULL_PAGE])
+
+    def test_peak_used(self):
+        alloc = PageAllocator(6, 8)
+        pages = alloc.alloc(4)
+        alloc.decref(pages)
+        assert alloc.peak_used == 4
+        assert alloc.used_pages == 0
+
+
+class TestPrefixCache:
+    def test_lookup_caps_below_full_prompt(self):
+        """At least one prompt token must re-prefill: a prompt of exactly
+        k pages only ever matches a (k-1)-page prefix."""
+        alloc = PageAllocator(8, 4)
+        cache = PrefixCache(alloc)
+        prompt = list(range(8))  # exactly 2 pages
+        pages = alloc.alloc(2)
+        cache.register(prompt, pages)
+        got, n = cache.lookup(prompt)
+        assert n == 4 and got == pages[:1]
+        alloc.decref(got)
+
+    def test_register_lookup_roundtrip_increfs(self):
+        alloc = PageAllocator(8, 4)
+        cache = PrefixCache(alloc)
+        prompt = list(range(10))  # 2 full pages + tail
+        pages = alloc.alloc(pages_needed(10, 4))
+        assert cache.register(prompt, pages) == 2
+        got, n = cache.lookup(prompt + [99])
+        assert n == 8 and got == pages[:2]
+        # owner + the k=1 entry + the k=2 entry + lookup
+        assert alloc.refcount(pages[0]) == 4
+        assert alloc.refcount(pages[1]) == 3  # owner + k=2 entry + lookup
+        # different tokens: no hit
+        assert cache.lookup([7] * 10) == ([], 0)
+        assert cache.stats()["hits"] == 1 and cache.stats()["lookups"] == 2
+
+    def test_eviction_respects_live_refs(self):
+        """Evicting an entry drops only the cache's reference: a page a live
+        request still holds stays allocated."""
+        alloc = PageAllocator(4, 4)
+        cache = PrefixCache(alloc)
+        prompt = list(range(5))
+        pages = alloc.alloc(2)
+        cache.register(prompt, pages)
+        shared, _ = cache.lookup(prompt)  # live consumer increfs pages[0]
+        freed = cache.clear()
+        assert freed == 0  # owner + consumer refs keep everything alive
+        alloc.decref(pages)  # owner retires
+        assert alloc.refcount(shared[0]) == 1  # consumer still holds it
+        assert alloc.decref(shared) == 1
+
+    def test_lru_capacity(self):
+        alloc = PageAllocator(16, 2)
+        cache = PrefixCache(alloc, max_entries=2)
+        for start in (0, 10, 20):
+            pages = alloc.alloc(1)
+            cache.register([start, start + 1, start + 2], pages)
+            alloc.decref(pages)
+        assert len(cache) == 2
+        assert cache.lookup([0, 1, 2]) == ([], 0)  # oldest evicted
+        got, _ = cache.lookup([20, 21, 22])
+        assert got
+        alloc.decref(got)
+
+
+# -- engine: chunked prefill and memory --------------------------------------
+
+
+def make_engines(cfg, *, cache_size=32, page_size=8, num_pages=None, chunk_size=8):
+    model = build_decode_model(cfg, cache_size=cache_size)
+    base = type(model)(cfg, lora=None, dtype=jnp.float32, scan_layers=True)
+    params = init_params(base, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    contiguous = InferenceEngine(cfg, params, cache_size=cache_size)
+    paged = InferenceEngine(
+        cfg,
+        params,
+        cache_size=cache_size,
+        page_size=page_size,
+        num_pages=num_pages or 3 * (cache_size // page_size) + 1,
+        chunk_size=chunk_size,
+    )
+    return contiguous, paged
+
+
+@pytest.mark.parametrize("cfg", [TINY_LLAMA, TINY_NEOX], ids=["llama", "neox"])
+@pytest.mark.parametrize("chunk", [4, 8, 32])
+def test_chunked_prefill_matches_whole(cfg, chunk):
+    """Driving a prompt through fixed-size prefill chunks produces the same
+    logits at every real position as one whole contiguous prefill — checked
+    at every chunk boundary, including the ragged last chunk."""
+    contiguous, paged = make_engines(cfg, chunk_size=chunk)
+    L = 13
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(3), (L,), 0, cfg.vocab_size)
+    )
+    whole, _ = contiguous.prefill(jnp.asarray(prompt[None, :]))
+
+    pool = paged.init_pool()
+    table = np.zeros((1, paged.block_table_width), np.int32)
+    n_pages = pages_needed(L, paged.page_size)
+    table[0, :n_pages] = np.arange(1, n_pages + 1)
+    for start in range(0, L, chunk):
+        ids = np.zeros((1, chunk), np.int32)
+        n_real = min(chunk, L - start)
+        ids[0, :n_real] = prompt[start : start + n_real]
+        logits, pool = paged.prefill_chunk(jnp.asarray(ids), start, pool, table)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, :n_real]),
+            np.asarray(whole[:, start : start + n_real]),
+            atol=1e-5,
+        )
+
+
+def test_memory_plans_pool_scales_with_pages():
+    """The paged kv_cache entry is the page pool: bytes scale with num_pages
+    and undercut the contiguous max_batch × cache_size reservation."""
+    contiguous, paged = make_engines(TINY_LLAMA, num_pages=13)
+    small = paged.memory_plans(4)["pytree"]["kv_cache_bytes"]
+    _, bigger = make_engines(TINY_LLAMA, num_pages=25)
+    big = bigger.memory_plans(4)["pytree"]["kv_cache_bytes"]
+    assert big / small == pytest.approx(25 / 13, rel=1e-6)
+    contiguous_kv = contiguous.memory_plans(4)["pytree"]["kv_cache_bytes"]
+    # 12 usable pages × 8 tokens = 96 cache entries vs 4 × 32 = 128
+    assert small < contiguous_kv
+
+
+def test_warmup_covers_all_shapes_no_retrace():
+    """Paged warmup compiles the chunk + decode pair; afterwards a drain of
+    mixed prompt lengths (short, page-straddling, multi-chunk) triggers no
+    steady-state retrace."""
+    _, paged = make_engines(TINY_LLAMA, chunk_size=8)
+    report = paged.warmup(2)
+    assert report["shapes"] == {"prefill_chunk": [1, 8], "decode_paged": [2, 1]}
+    sched = PagedContinuousBatchingScheduler(paged, max_batch=2)
+    reqs = [
+        Request(uid=i, prompt=list(range(1, L + 1)), max_new_tokens=3)
+        for i, L in enumerate((2, 7, 9, 17, 23))
+    ]
+    sched.run(reqs)
+    assert paged.compile_watcher.steady_state_retraces == 0
+
+
+def test_contiguous_default_warmup_covers_every_bucket():
+    """Satellite: warmup's default prompt_buckets covers every power-of-two
+    bucket up to capacity, so a long prompt after warmup never retraces."""
+    contiguous, _ = make_engines(TINY_LLAMA)
+    assert contiguous.default_prompt_buckets() == (16, 32)
+    report = contiguous.warmup(2)
+    assert report["prompt_buckets"] == [16, 32]
+    sched = ContinuousBatchingScheduler(contiguous, max_batch=2)
+    sched.run([Request(uid=0, prompt=list(range(1, 25)), max_new_tokens=4)])
+    assert contiguous.compile_watcher.steady_state_retraces == 0
+
+
+# -- scheduler: the token-parity oracle ---------------------------------------
+
+
+def mixed_requests(vocab):
+    """Mixed lengths (page-straddling + multi-chunk), greedy AND sampled,
+    staggered through max_batch=2 slots, with uid 4 likely to hit EOS."""
+    rng = np.random.default_rng(11)
+    mk = lambda uid, L, new, **kw: Request(
+        uid=uid, prompt=rng.integers(1, vocab, L).tolist(), max_new_tokens=new, **kw
+    )
+    return [
+        mk(1, 13, 6),
+        mk(2, 5, 9, temperature=0.8, top_p=0.9),
+        mk(3, 21, 4),
+        mk(4, 3, 7, temperature=1.1),
+    ]
+
+
+def drain(sched_cls, engine, reqs, **kwargs):
+    sched = sched_cls(engine, max_batch=2, eos_id=9, key=jax.random.PRNGKey(42), **kwargs)
+    completions = sched.run(reqs)
+    return sched, {uid: c.tokens for uid, c in completions.items()}
+
+
+@pytest.mark.parametrize("cfg", [TINY_LLAMA, TINY_NEOX], ids=["llama", "neox"])
+def test_paged_drain_token_identical_to_contiguous(cfg):
+    contiguous, paged = make_engines(cfg)
+    reqs = mixed_requests(cfg.vocab_size)
+    _, want = drain(ContinuousBatchingScheduler, contiguous, reqs)
+    sched, got = drain(PagedContinuousBatchingScheduler, paged, reqs)
+    assert got == want
+    # all request pages released: only prefix-cache refs remain, and
+    # clearing the cache drains the allocator completely
+    sched.prefix_cache.clear()
+    assert sched.allocator.used_pages == 0
+
+
+def test_paged_parity_without_prefix_cache():
+    contiguous, paged = make_engines(TINY_LLAMA)
+    reqs = mixed_requests(TINY_LLAMA.vocab_size)
+    _, want = drain(ContinuousBatchingScheduler, contiguous, reqs)
+    sched, got = drain(
+        PagedContinuousBatchingScheduler, paged, reqs, prefix_cache=False
+    )
+    assert got == want
+    assert sched.allocator.used_pages == 0
+
+
+def test_cancel_mid_decode_frees_pages():
+    _, paged = make_engines(TINY_LLAMA)
+    sched = PagedContinuousBatchingScheduler(paged, max_batch=2, prefix_cache=False)
+    free0 = sched.allocator.free_pages
+    sched.submit(Request(uid=1, prompt=[1, 2, 3, 4, 5], max_new_tokens=8))
+    sched.submit(Request(uid=2, prompt=[6, 7, 8], max_new_tokens=8))
+    for _ in range(3):  # both prefilled, a few decode steps in
+        sched.step()
+    assert sched.active_slots == 2
+    completion = sched.cancel(1)
+    assert completion.finish_reason == "cancelled" and completion.tokens
+    assert sched.allocator.free_pages == free0 - pages_needed(
+        3 + 8, paged.page_size
+    )
+    while sched.has_work():
+        sched.step()
+    assert sched.allocator.free_pages == free0  # pinned: no page leaked
+
+
+def test_pool_exhaustion_queues_fifo():
+    """When the pool cannot cover the queue head, it stays queued — FIFO, no
+    skip-ahead — and admits once the running request retires."""
+    # 5 usable pages of 8: one request reserves ceil((13+6)/8)=3
+    _, paged = make_engines(TINY_LLAMA, num_pages=6)
+    sched = PagedContinuousBatchingScheduler(paged, max_batch=2, prefix_cache=False)
+    sched.submit(Request(uid=1, prompt=list(range(1, 14)), max_new_tokens=6))
+    sched.submit(Request(uid=2, prompt=list(range(1, 14)), max_new_tokens=6))
+    sched.submit(Request(uid=3, prompt=[1, 2], max_new_tokens=2))  # would fit!
+    sched.step()
+    # head (uid 2) needs 3 pages, only 2 free: stays queued, and uid 3 does
+    # NOT jump the line even though its 1 page would fit
+    assert sched.active_slots == 1 and sched.queue_depth == 2
+    done = {}
+    while sched.has_work():
+        for c in sched.step():
+            done[c.uid] = c
+    assert set(done) == {1, 2, 3}
+    assert done[1].tokens == done[2].tokens  # same prompt, both greedy
+    assert sched.allocator.used_pages == 0
+
+
+def test_prefix_hit_serves_identical_tokens():
+    """A prompt served through shared prefix pages produces exactly the
+    tokens the cold run produced — and the shared pages survive the donor
+    retiring (refcounts, not ownership)."""
+    _, paged = make_engines(TINY_LLAMA)
+    sched = PagedContinuousBatchingScheduler(paged, max_batch=2)
+    prompt = list(range(1, 22))  # 21 tokens: 2 full shareable pages
+    cold = sched.run([Request(uid=1, prompt=prompt, max_new_tokens=5)])[1].tokens
+    assert sched.prefix_cache.stats()["entries"] > 0
+    # donor finished; its pages persist only through the cache's refs
+    warm = sched.run([Request(uid=2, prompt=prompt, max_new_tokens=5)])[2].tokens
+    assert warm == cold
+    assert sched.prefix_cache.hits >= 1
+    # a longer prompt sharing the prefix also matches its cold equivalent
+    longer = prompt + [30, 31, 32]
+    warm_long = sched.run([Request(uid=3, prompt=longer, max_new_tokens=5)])[3].tokens
+    fresh = PagedContinuousBatchingScheduler(paged, max_batch=2, prefix_cache=False)
+    cold_long = fresh.run([Request(uid=4, prompt=longer, max_new_tokens=5)])[4].tokens
+    assert warm_long == cold_long
+
+
+def test_prefix_eviction_never_corrupts_active_request():
+    """Allocation pressure evicts prefix entries while a consumer request is
+    mid-decode on those shared pages; its output must not change."""
+    # 5 usable pages: uid2 (2 shared + 1 fresh) + uid3 (3 fresh) overflows,
+    # so uid3's admission forces prefix eviction while uid2 is live
+    _, paged = make_engines(TINY_LLAMA, num_pages=6)
+    reference = PagedContinuousBatchingScheduler(paged, max_batch=2, prefix_cache=False)
+    prompt = list(range(1, 18))  # 17 tokens: 2 shareable pages of 8
+    want = reference.run([Request(uid=0, prompt=prompt, max_new_tokens=6)])[0].tokens
+
+    sched = PagedContinuousBatchingScheduler(paged, max_batch=2)
+    assert sched.run([Request(uid=1, prompt=prompt, max_new_tokens=6)])[1].tokens == want
+    # consumer admits on the shared pages, then pressure from uid 3 forces
+    # prefix eviction mid-flight (9 usable pages: 3+3 live + 2 cached > 9)
+    sched.submit(Request(uid=2, prompt=prompt, max_new_tokens=6))
+    sched.step()  # admit + first chunk; holds the shared pages
+    assert sched.prefix_cache.hits >= 1
+    sched.submit(Request(uid=3, prompt=list(range(40, 57)), max_new_tokens=6))
+    done = {}
+    while sched.has_work():
+        for c in sched.step():
+            done[c.uid] = c
+    assert done[2].tokens == want  # eviction dropped refs, not live pages
+    sched.prefix_cache.clear()
+    assert sched.allocator.used_pages == 0
+
+
+def test_paged_metrics_records(tmp_path):
+    """Satellite: the paged scheduler's per-step records carry the pool and
+    prefix gauges, and the request records still appear."""
+    _, paged = make_engines(TINY_LLAMA)
+    metrics = MetricsLogger(run_dir=str(tmp_path))
+    sched = PagedContinuousBatchingScheduler(paged, max_batch=2, metrics=metrics)
+    sched.run([Request(uid=1, prompt=list(range(1, 14)), max_new_tokens=4)])
+    metrics.finish()
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    steps = [r for r in records if "serve/decode_step" in r]
+    assert steps, records
+    for key in (
+        "serve/kv_pages_used",
+        "serve/kv_pages_free",
+        "serve/prefix_cache_hit_rate",
+        "serve/prefill_pad_share",
+        "serve/batch_fill",
+        "serve/prefill_stall_share",
+    ):
+        assert key in steps[-1], key
+    assert steps[-1]["serve/kv_pages_used"] >= 0
+    assert any("serve_request" in r for r in records)
+
+
+def test_paged_scheduler_rejects_contiguous_engine():
+    contiguous, _ = make_engines(TINY_LLAMA)
+    with pytest.raises(ValueError, match="page_size"):
+        PagedContinuousBatchingScheduler(contiguous, max_batch=2)

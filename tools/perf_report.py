@@ -14,7 +14,9 @@ Joins the three telemetry streams the obs layer produces into the answer to
    expected vs steady-state retraces (the number that should be zero), and
    the signature diff of any retrace.
 4. **Serving utilization** — ``serve/batch_fill`` and prefill-stall share
-   when the run dir came from the scheduler.
+   when the run dir came from the scheduler; paged runs add KV-pool pressure
+   (``serve/kv_pages_used``/``free``), prefix-cache hit rate, and the
+   chunked-prefill padding share.
 5. **Span phases** — p50/p95 per phase from a ``train_spans.jsonl`` stream
    (``--traces``, or auto-detected next to the run dir).
 6. **BENCH trajectory** — committed ``BENCH_*.json`` context (``--bench-dir``).
@@ -184,6 +186,22 @@ def print_serving(records: List[Dict[str, Any]], out) -> None:
         f"  batch fill      mean {mean(fills) * 100:5.1f}%  min {min(fills) * 100:5.1f}%"
         f"  max {max(fills) * 100:5.1f}%\n"
         f"  prefill stall   mean {mean(stalls) * 100:5.1f}% of step time\n"
+    )
+    # paged-KV pool pressure (PagedContinuousBatchingScheduler runs only)
+    paged_steps = [r for r in steps if "serve/kv_pages_used" in r]
+    if not paged_steps:
+        return
+    used = [r["serve/kv_pages_used"] for r in paged_steps]
+    free = [r["serve/kv_pages_free"] for r in paged_steps]
+    total = used[-1] + free[-1]
+    pads = [r.get("serve/prefill_pad_share", 0.0) for r in paged_steps]
+    # hit rate is cumulative: the last record is the run's rate
+    hit_rate = paged_steps[-1].get("serve/prefix_cache_hit_rate", 0.0)
+    out.write(
+        f"  kv pages        mean {mean(used):7.1f} used  peak {max(used)} "
+        f"of {total}  (min free {min(free)})\n"
+        f"  prefix cache    hit rate {hit_rate * 100:5.1f}%\n"
+        f"  prefill pad     {pads[-1] * 100:5.1f}% of chunked prefill tokens\n"
     )
 
 
