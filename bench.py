@@ -40,7 +40,13 @@ closed-loop, and writes throughput, p50/p95 TTFT and TPOT, and rejection rate
 per level to ``BENCH_http.json``.  Env: BENCH_HTTP_MODEL (default llama_9m),
 BENCH_HTTP_MAX_BATCH, BENCH_HTTP_QUEUE, BENCH_HTTP_QPS ("4,16,64"),
 BENCH_HTTP_DURATION, BENCH_HTTP_PROMPT_LEN, BENCH_HTTP_NEW_TOKENS.  Runs on
-any backend, CPU included — the device lands in the artifact.
+any backend, CPU included — the device lands in the artifact.  With
+``--router`` it additionally boots a 2-replica subprocess fleet
+(``serve.py --random-init`` under ReplicaSupervisor) behind the
+health-aware Router and drives the same open-loop load twice — once clean,
+once SIGKILLing replica 0 mid-run — recording failover/retry counts, typed
+mid-stream errors, hung requests (must be 0), and p95 TTFT for both runs
+under ``detail.router``.
 
 ``--mode obs_overhead`` measures what the span tracer (relora_tpu/obs) costs
 on the training hot path: the same tiny jitted train step is driven twice,
@@ -354,9 +360,10 @@ def decode_main() -> None:
     print(json.dumps(result))
 
 
-def serve_load_main() -> None:
+def serve_load_main(router: bool = False) -> None:
     """--mode serve_load: closed+open-loop load generator against the HTTP
-    serving front-end, in one process over loopback."""
+    serving front-end, in one process over loopback.  ``router=True`` adds
+    the multi-replica failover phase (subprocess fleet + Router)."""
     import asyncio
     import time
 
@@ -432,7 +439,7 @@ def serve_load_main() -> None:
             return long_prompts[(i // long_every) % len(long_prompts)]
         return prompts[i % len(prompts)]
 
-    async def one_request(i: int) -> dict:
+    async def one_request(i: int, port: int = 0) -> dict:
         payload = {
             "prompt": pick_prompt(i),
             "max_new_tokens": new_tokens,
@@ -440,7 +447,7 @@ def serve_load_main() -> None:
         }
         body = json.dumps(payload).encode()
         t_send = time.perf_counter()
-        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port or server.port)
         writer.write(
             (
                 "POST /v1/generate HTTP/1.1\r\nHost: bench\r\n"
@@ -453,7 +460,7 @@ def serve_load_main() -> None:
         status = int((await reader.readline()).split()[1])
         while (await reader.readline()).strip():
             pass  # headers
-        token_times, finish = [], None
+        token_times, finish, error_event = [], None, None
         if status == 200:
             buf = b""
             while True:
@@ -470,6 +477,8 @@ def serve_load_main() -> None:
                         token_times.append(time.perf_counter())
                     elif "finish_reason" in event:
                         finish = event
+                    elif "error" in event:
+                        error_event = event["error"]
         writer.close()
         try:
             await writer.wait_closed()
@@ -480,6 +489,7 @@ def serve_load_main() -> None:
             "t_send": t_send,
             "token_times": token_times,
             "tokens": len(finish["tokens"]) if finish else 0,
+            "error_event": error_event,
         }
 
     def summarize(level, results, wall: float) -> dict:
@@ -582,7 +592,143 @@ def serve_load_main() -> None:
         await serve_task
         return rows
 
+    # -- multi-replica failover phase (--router) ------------------------------
+
+    async def guarded_request(i: int, port: int, results: list) -> None:
+        """one_request that can never hang the bench: a request still open
+        after 90s is recorded as hung — the exact failure the router layer
+        exists to prevent."""
+        try:
+            r = await asyncio.wait_for(one_request(i, port=port), timeout=90.0)
+        except asyncio.TimeoutError:
+            r = {
+                "status": -1, "t_send": 0.0, "token_times": [],
+                "tokens": 0, "error_event": None, "hung": True,
+            }
+        except (ConnectionError, OSError) as e:
+            r = {
+                "status": -2, "t_send": 0.0, "token_times": [],
+                "tokens": 0, "error_event": repr(e),
+            }
+        results.append(r)
+
+    def router_phase() -> dict:
+        """2 serve.py --random-init replicas under ReplicaSupervisor behind
+        the Router; the same open-loop load twice — clean, then with replica
+        0 SIGKILLed mid-run."""
+        import signal as _signal
+        import tempfile
+        import threading as _threading
+
+        from relora_tpu.serve.router import Router
+        from relora_tpu.serve.supervisor import ReplicaSupervisor
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        workdir = tempfile.mkdtemp(prefix="bench_router_")
+        sup = ReplicaSupervisor(
+            [
+                sys.executable, os.path.join(here, "serve.py"),
+                "--model_config", model_name, "--random-init",
+                "--max-batch", str(max_batch), "--max-queue", str(max_queue),
+                "--no-warmup",
+            ],
+            2,
+            workdir,
+            backoff_base_s=0.1,
+            backoff_cap_s=1.0,
+            backoff_jitter=0.0,
+            poll_interval_s=0.05,
+        )
+        rtr = Router(
+            sup.endpoints, port=0, probe_interval_s=0.1,
+            retry_backoff_s=0.02, failure_threshold=2, cooldown_s=0.2,
+        )
+        rtr_thread = _threading.Thread(
+            target=lambda: asyncio.run(rtr.serve_forever()), daemon=True
+        )
+        qps = qps_levels[0] if qps_levels else 4.0
+        r_duration = max(duration, 4.0)
+
+        async def drive(level: str, kill_at) -> dict:
+            interval, n = 1.0 / qps, max(1, int(r_duration * qps))
+            results, tasks = [], []
+            killed = False
+            t0 = time.perf_counter()
+            for i in range(n):
+                delay = i * interval - (time.perf_counter() - t0)
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                if kill_at is not None and not killed and time.perf_counter() - t0 >= kill_at:
+                    sup.send_signal(0, _signal.SIGKILL)
+                    killed = True
+                tasks.append(asyncio.ensure_future(guarded_request(i, rtr.port, results)))
+            await asyncio.gather(*tasks)
+            row = summarize(level, results, time.perf_counter() - t0)
+            row["typed_errors"] = sum(1 for r in results if r.get("error_event"))
+            row["hung_requests"] = sum(1 for r in results if r.get("hung"))
+            return row
+
+        async def warm() -> None:
+            # no --no-warmup-free lunch: pay each replica's prefill-bucket
+            # compiles (long prompt = i 0, short = i 1) outside the timed runs
+            for _rid, (_h, p) in sorted(sup.endpoints().items()):
+                if p:
+                    await one_request(0, port=p)
+                    await one_request(1, port=p)
+
+        restarted = False
+        try:
+            sup.start()
+            rtr_thread.start()
+            if not rtr.started.wait(30):
+                raise RuntimeError("router failed to start")
+            deadline = time.monotonic() + 180.0
+            while time.monotonic() < deadline:
+                if sum(st.healthy for st in rtr.replicas.values()) >= 2:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(f"fleet never became healthy: {sup.status()}")
+            asyncio.run(warm())
+            clean = asyncio.run(drive("router:clean", None))
+            kill = asyncio.run(drive("router:kill", r_duration * 0.3))
+            # the killed replica must come back and be routable again
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if (
+                    sup.status()["r0"]["restarts"] >= 1
+                    and sum(st.healthy for st in rtr.replicas.values()) >= 2
+                ):
+                    restarted = True
+                    break
+                time.sleep(0.2)
+            snap = rtr.stats.snapshot()
+        finally:
+            rtr.begin_shutdown()
+            rtr_thread.join(30)
+            sup.stop()
+
+        failovers = int(sum(v for k, v in snap.items() if k.startswith("failovers_total")))
+        retries = int(snap.get("retries_total", 0))
+        sent = clean["sent"] + kill["sent"]
+        return {
+            "replicas": 2,
+            "offered_qps": qps,
+            "duration_s_per_level": r_duration,
+            "clean": clean,
+            "kill": kill,
+            "failover_count": failovers,
+            "retries_total": retries,
+            "retry_rate": round(retries / max(sent, 1), 4),
+            "midstream_errors": int(
+                sum(v for k, v in snap.items() if k.startswith("midstream_errors_total"))
+            ),
+            "hung_requests": clean["hung_requests"] + kill["hung_requests"],
+            "replica0_restarted": restarted,
+        }
+
     rows = asyncio.run(bench())
+    router_detail = router_phase() if router else None
     peak = max(rows, key=lambda r: r["throughput_tokens_per_s"])
     saturated = max(rows, key=lambda r: r["reject_rate"])
     result = {
@@ -614,6 +760,7 @@ def serve_load_main() -> None:
             ),
             "reject_rate_at_saturation": saturated["reject_rate"],
             "levels": rows,
+            **({"router": router_detail} if router_detail is not None else {}),
         },
     }
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_http.json")
@@ -815,6 +962,12 @@ if __name__ == "__main__":
         choices=["train", "decode", "lint", "lora_kernel", "serve_load", "obs_overhead"],
         default="train",
     )
+    _ap.add_argument(
+        "--router",
+        action="store_true",
+        help="serve_load: add the 2-replica failover phase (subprocess fleet "
+        "behind the health-aware router, with a mid-run SIGKILL)",
+    )
     _cli = _ap.parse_args()
     if _cli.mode == "lint":
         lint_main()
@@ -826,7 +979,7 @@ if __name__ == "__main__":
         decode_main()
         sys.exit(0)
     if _cli.mode == "serve_load":
-        serve_load_main()
+        serve_load_main(router=_cli.router)
         sys.exit(0)
     if _cli.mode == "lora_kernel":
         lora_kernel_main()

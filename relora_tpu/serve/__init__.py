@@ -6,40 +6,52 @@ package runs it — ``engine.InferenceEngine`` for the jitted prefill/decode
 steps, ``sampling`` for jittable token selection, ``scheduler`` for the
 slot-based continuous-batching core (incremental ``submit``/``step``/
 ``cancel``), ``admission``/``server`` for the online HTTP front-end
-(bounded admission, SSE streaming, graceful drain).  The ``serve.py`` CLI
-at the repo root ties them to checkpoint loading.
+(bounded admission, SSE streaming, graceful drain), ``router``/
+``supervisor`` for the multi-replica tier (health-aware failover, crash
+restarts, rolling drain).  The ``serve.py`` CLI at the repo root ties them
+to checkpoint loading.
+
+Lazy exports (same idiom as the top-level package): the router and
+supervisor run in front-end processes that must never pay a jax import, so
+``import relora_tpu.serve.router`` cannot afford an ``__init__`` that pulls
+in the engine eagerly.
 """
 
-from relora_tpu.serve.admission import AdmissionController, Draining, QueueFull, ServeMetrics, Ticket
-from relora_tpu.serve.engine import InferenceEngine, build_decode_model, bucket_length
-from relora_tpu.serve.paging import PageAllocator, PrefixCache, pages_needed
-from relora_tpu.serve.sampling import SamplingParams, sample
-from relora_tpu.serve.scheduler import (
-    Completion,
-    ContinuousBatchingScheduler,
-    PagedContinuousBatchingScheduler,
-    Request,
-)
-from relora_tpu.serve.server import GenerateServer, run_server
+_API = {
+    "AdmissionController": "relora_tpu.serve.admission",
+    "Draining": "relora_tpu.serve.admission",
+    "QueueFull": "relora_tpu.serve.admission",
+    "ServeMetrics": "relora_tpu.serve.admission",
+    "Ticket": "relora_tpu.serve.admission",
+    "InferenceEngine": "relora_tpu.serve.engine",
+    "build_decode_model": "relora_tpu.serve.engine",
+    "bucket_length": "relora_tpu.serve.engine",
+    "PageAllocator": "relora_tpu.serve.paging",
+    "PrefixCache": "relora_tpu.serve.paging",
+    "pages_needed": "relora_tpu.serve.paging",
+    "SamplingParams": "relora_tpu.serve.sampling",
+    "sample": "relora_tpu.serve.sampling",
+    "Completion": "relora_tpu.serve.scheduler",
+    "ContinuousBatchingScheduler": "relora_tpu.serve.scheduler",
+    "PagedContinuousBatchingScheduler": "relora_tpu.serve.scheduler",
+    "Request": "relora_tpu.serve.scheduler",
+    "GenerateServer": "relora_tpu.serve.server",
+    "run_server": "relora_tpu.serve.server",
+    "CircuitBreaker": "relora_tpu.serve.router",
+    "Router": "relora_tpu.serve.router",
+    "ReplicaSupervisor": "relora_tpu.serve.supervisor",
+}
 
-__all__ = [
-    "AdmissionController",
-    "Completion",
-    "ContinuousBatchingScheduler",
-    "Draining",
-    "GenerateServer",
-    "InferenceEngine",
-    "PageAllocator",
-    "PagedContinuousBatchingScheduler",
-    "PrefixCache",
-    "QueueFull",
-    "Request",
-    "SamplingParams",
-    "ServeMetrics",
-    "Ticket",
-    "bucket_length",
-    "build_decode_model",
-    "pages_needed",
-    "run_server",
-    "sample",
-]
+__all__ = sorted(_API)
+
+
+def __getattr__(name):
+    if name in _API:
+        import importlib
+
+        return getattr(importlib.import_module(_API[name]), name)
+    raise AttributeError(f"module 'relora_tpu.serve' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_API))

@@ -87,14 +87,41 @@ class AdmissionController:
     ``max_batch`` decoding + ``max_queue`` waiting, both fixed.
     """
 
+    #: Retry-After never exceeds this; a longer hint just loses the client.
+    RETRY_AFTER_CAP_S = 30.0
+
     def __init__(self, max_queue: int, *, retry_after_s: float = 1.0):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = max_queue
-        self.retry_after_s = retry_after_s
+        self.retry_after_floor_s = retry_after_s
         self._q: "queue.Queue[Ticket]" = queue.Queue(maxsize=max_queue)
         self._uids = itertools.count()
         self._draining = threading.Event()
+        self._tpot_ewma: Optional[float] = None  # model thread writes, any reads
+
+    @property
+    def retry_after_s(self) -> float:
+        """Load-aware Retry-After hint: the time for the current queue to
+        clear at the observed decode rate (queue depth × rolling TPOT),
+        clamped to ``[max(1, floor), RETRY_AFTER_CAP_S]``.  Before any token
+        has been observed (cold server) it falls back to the floor — the old
+        fixed behaviour."""
+        floor = max(1.0, self.retry_after_floor_s)
+        if self._tpot_ewma is None:
+            return floor
+        estimate = self._q.qsize() * self._tpot_ewma
+        return min(max(floor, estimate), self.RETRY_AFTER_CAP_S)
+
+    def note_tpot(self, seconds: float) -> None:
+        """Model thread: fold one observed per-token latency into the rolling
+        TPOT estimate behind :attr:`retry_after_s`."""
+        if seconds <= 0.0:
+            return
+        if self._tpot_ewma is None:
+            self._tpot_ewma = seconds
+        else:
+            self._tpot_ewma = 0.8 * self._tpot_ewma + 0.2 * seconds
 
     @property
     def draining(self) -> bool:

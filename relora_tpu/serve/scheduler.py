@@ -85,10 +85,11 @@ class Request:
 class Completion:
     uid: int
     tokens: List[int]
-    finish_reason: str  # "eos" | "length" | "timeout" | "cancelled"
+    finish_reason: str  # "eos" | "length" | "timeout" | "cancelled" | "error"
     prompt_tokens: int
     ttft_s: float
     latency_s: float
+    error: Optional[str] = None  # reader-facing detail when finish_reason="error"
 
 
 @dataclasses.dataclass
@@ -201,18 +202,38 @@ class ContinuousBatchingScheduler:
         if self.obs_registry is not None:
             self.obs_registry.observe(name, value)
 
-    def cancel(self, uid: int, reason: str = "cancelled") -> Optional[Completion]:
+    def cancel(
+        self, uid: int, reason: str = "cancelled", detail: Optional[str] = None
+    ) -> Optional[Completion]:
         """Free a request's slot (or drop it from the pending queue) and
         report its partial output.  Returns the Completion, or None when the
         uid is unknown (already finished — cancellation raced completion)."""
         for req in list(self._pending):
             if req.uid == uid:
                 self._pending.remove(req)
-                return self._finalize_unadmitted(req, reason)
+                return self._finalize_unadmitted(req, reason, detail)
         for slot_idx, slot in enumerate(self._slots):
             if slot is not None and slot.request.uid == uid:
-                return self._retire(slot_idx, reason)
+                return self._retire(slot_idx, reason, detail)
         return None
+
+    def fail_all(
+        self, reason: str = "error", detail: Optional[str] = None
+    ) -> List[Completion]:
+        """Terminally complete every queued and active request — the
+        model-thread-death path.  Each request gets whatever tokens it
+        already produced plus ``finish_reason=reason`` (callbacks fire as
+        usual), so no stream is ever left hanging on a dead worker.  Pure
+        host-side bookkeeping: never touches the device, so it is safe to
+        call after the jitted step itself blew up."""
+        completions: List[Completion] = []
+        for req in list(self._pending):
+            self._pending.remove(req)
+            completions.append(self._finalize_unadmitted(req, reason, detail))
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is not None:
+                completions.append(self._retire(slot_idx, reason, detail))
+        return completions
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s is not None for s in self._slots)
@@ -441,8 +462,10 @@ class ContinuousBatchingScheduler:
             return
         finished.append(self._retire(slot_idx, reason))
 
-    def _retire(self, slot_idx: int, reason: str) -> Completion:
-        """Evict a slot (EOS / budget / timeout / cancel): build the
+    def _retire(
+        self, slot_idx: int, reason: str, detail: Optional[str] = None
+    ) -> Completion:
+        """Evict a slot (EOS / budget / timeout / cancel / error): build the
         Completion, free the row — nothing recompiles — and notify."""
         slot = self._slots[slot_idx]
         req = slot.request
@@ -454,6 +477,7 @@ class ContinuousBatchingScheduler:
             prompt_tokens=len(req.prompt),
             ttft_s=slot.t_first - slot.t_admit,
             latency_s=now - slot.t_admit,
+            error=detail,
         )
         self._slots[slot_idx] = None  # evict: slot is free, nothing recompiles
         if slot.span is not None:
@@ -479,7 +503,9 @@ class ContinuousBatchingScheduler:
         self._finalize(completion)
         return completion
 
-    def _finalize_unadmitted(self, req: Request, reason: str) -> Completion:
+    def _finalize_unadmitted(
+        self, req: Request, reason: str, detail: Optional[str] = None
+    ) -> Completion:
         """A request that never reached a slot (cancelled or expired while
         queued): empty output, zero latency fields."""
         completion = Completion(
@@ -489,6 +515,7 @@ class ContinuousBatchingScheduler:
             prompt_tokens=len(req.prompt),
             ttft_s=0.0,
             latency_s=0.0,
+            error=detail,
         )
         if self.metrics is not None:
             self.metrics.log(
@@ -789,9 +816,11 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
 
     # -- retirement (page bookkeeping) ----------------------------------------
 
-    def _retire(self, slot_idx: int, reason: str) -> Completion:
+    def _retire(
+        self, slot_idx: int, reason: str, detail: Optional[str] = None
+    ) -> Completion:
         slot = self._slots[slot_idx]
-        completion = super()._retire(slot_idx, reason)
+        completion = super()._retire(slot_idx, reason, detail)
         if slot.pages:
             # one decref per page: fresh pages drop their alloc ref, shared
             # pages drop this request's lookup ref (the prefix cache's own

@@ -14,8 +14,11 @@ Endpoints:
   final ``data: {...finish record...}`` with the full token list and
   latency fields, then ``data: [DONE]``.  ``"stream": false`` returns the
   finish record as a single JSON body.
-- ``GET /healthz`` — readiness: 200 while accepting, 503 while draining
-  (load balancers stop routing before the listener goes away).
+- ``GET /healthz`` — readiness: 200 while accepting; 503 with ``status``
+  ``"draining"`` (SIGTERM), ``"stuck"`` (stall watchdog: no decode step for
+  ``stall_timeout_s``), or ``"error"`` (model thread died) — the router
+  (serve/router.py) ejects a replica on any 503 and re-adopts it when the
+  status clears.
 - ``GET /metrics`` — Prometheus text exposition (serve/admission.ServeMetrics).
 
 Flow control, end to end:
@@ -46,6 +49,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Set, Tuple
 
+from relora_tpu.obs.flight import dump_on_fault
 from relora_tpu.obs.tracer import NoopTracer, Tracer, new_trace_id
 from relora_tpu.serve.admission import (
     AdmissionController,
@@ -59,17 +63,24 @@ from relora_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
     Request,
 )
+from relora_tpu.serve.wire import (
+    head as _head,
+    read_http_request as _read_http_request,
+    respond as _respond,
+    respond_json as _respond_json,
+    sse as _sse,
+)
+from relora_tpu.utils import faults
 from relora_tpu.utils.logging import MetricsLogger, get_logger
 
 logger = get_logger(__name__)
 
-_MAX_BODY_BYTES = 16 << 20
 _REQUEST_TIMEOUT_S = 30.0
 _IDLE_POP_S = 0.02
 
 
 def _completion_record(completion: Completion) -> Dict[str, Any]:
-    return {
+    record = {
         "uid": completion.uid,
         "finish_reason": completion.finish_reason,
         "tokens": completion.tokens,
@@ -78,6 +89,9 @@ def _completion_record(completion: Completion) -> Dict[str, Any]:
         "ttft_s": round(completion.ttft_s, 6),
         "latency_s": round(completion.latency_s, 6),
     }
+    if completion.error is not None:
+        record["error"] = completion.error
+    return record
 
 
 class BadRequest(Exception):
@@ -151,6 +165,8 @@ class GenerateServer:
         default_temperature: float = 0.0,
         default_top_p: float = 1.0,
         retry_after_s: float = 1.0,
+        stall_timeout_s: float = 0.0,
+        error_linger_s: float = 1.0,
         metrics: Optional[MetricsLogger] = None,
         tracer: Optional[Tracer] = None,
     ):
@@ -183,6 +199,20 @@ class GenerateServer:
             target=self._model_loop, name="serve-model", daemon=True
         )
         self._worker_error: Optional[BaseException] = None
+        # -- self-diagnosis ----------------------------------------------------
+        # stall watchdog: no decode step completed for stall_timeout_s while
+        # the scheduler had work -> healthz flips to 503 "stuck" + one flight
+        # dump per episode (0 disables; set it above your worst cold compile)
+        self.stall_timeout_s = stall_timeout_s
+        # after the model thread dies, keep the listener up this long so
+        # health probes observe the 503 "error" state (a router ejects on
+        # status, not just connection-refused) before the process exits
+        self.error_linger_s = error_linger_s
+        self._tokens_emitted = 0  # model thread only; feeds faults.serve_tick
+        self._last_step_t = time.monotonic()
+        self._model_busy = False  # model thread writes; watchdog reads
+        self._stuck = False  # watchdog writes; healthz reads
+        self._watchdog: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -214,6 +244,11 @@ class GenerateServer:
                 logger.warning("SIGTERM handler unavailable; use begin_drain()")
         self.stats.set_gauge("draining", 0)
         self._worker.start()
+        if self.stall_timeout_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serve-watchdog", daemon=True
+            )
+            self._watchdog.start()
         self.started.set()
         logger.info(f"serving on http://{self.host}:{self.port}")
         async with server:
@@ -248,6 +283,7 @@ class GenerateServer:
         sched = self.scheduler
         try:
             while True:
+                faults.serve_tick(self._tokens_emitted)  # serving drills only
                 while sched.active_slots + sched.queue_depth < sched.max_batch:
                     ticket = self.admission.pop(timeout=None)
                     if ticket is None:
@@ -260,9 +296,16 @@ class GenerateServer:
                     "queue_depth", self.admission.depth() + sched.queue_depth
                 )
                 self.stats.set_gauge("active_slots", sched.active_slots)
+                self.stats.set_gauge(
+                    "retry_after_s", round(self.admission.retry_after_s, 3)
+                )
                 if sched.has_work():
+                    self._model_busy = True
                     sched.step()
+                    self._last_step_t = time.monotonic()
                     continue
+                self._model_busy = False
+                self._last_step_t = time.monotonic()  # idle is not a stall
                 if self.admission.draining and self.admission.depth() == 0:
                     break
                 ticket = self.admission.pop(timeout=_IDLE_POP_S)
@@ -271,9 +314,104 @@ class GenerateServer:
         except BaseException as e:
             self._worker_error = e
             logger.error(f"model thread died: {e!r}")
+            self._fail_pending(e)
         finally:
             self.drained.set()
+            if self._worker_error is not None and self.error_linger_s > 0:
+                time.sleep(self.error_linger_s)
             self._signal_shutdown()
+
+    def _fail_pending(self, error: BaseException) -> None:
+        """Model-thread death: terminally complete every active and queued
+        request with ``finish_reason="error"`` instead of stranding its
+        stream until the client gives up.  Host-side bookkeeping only — safe
+        even when the jitted step itself is what blew up."""
+        detail = f"model thread died: {error!r}"
+        self.stats.set_gauge("model_dead", 1)
+        try:
+            # requests the scheduler owns (decoding or scheduler-queued):
+            # fail_all fires the normal on_finish wrappers, so metrics, spans
+            # and the SSE finish events all flow through the standard path
+            self.scheduler.fail_all(reason="error", detail=detail)
+        except Exception as e:
+            logger.error(f"fail_all after model-thread death failed too: {e!r}")
+            for _uid, ticket in list(self._active.items()):
+                self._active.pop(_uid, None)
+                try:
+                    ticket.on_finish(
+                        Completion(
+                            uid=ticket.uid,
+                            tokens=[],
+                            finish_reason="error",
+                            prompt_tokens=len(ticket.request.prompt),
+                            ttft_s=0.0,
+                            latency_s=0.0,
+                            error=detail,
+                        )
+                    )
+                except Exception:
+                    pass
+        # tickets still waiting in the admission queue, never claimed
+        while True:
+            ticket = self.admission.pop(timeout=None)
+            if ticket is None:
+                break
+            self.stats.inc("requests_finished_total", ("reason", "error"))
+            if ticket.queue_span is not None:
+                ticket.queue_span.set(outcome="error").end()
+            if ticket.span is not None:
+                ticket.span.set(finish_reason="error", output_tokens=0).end()
+            try:
+                ticket.on_finish(
+                    Completion(
+                        uid=ticket.uid,
+                        tokens=[],
+                        finish_reason="error",
+                        prompt_tokens=len(ticket.request.prompt),
+                        ttft_s=0.0,
+                        latency_s=0.0,
+                        error=detail,
+                    )
+                )
+            except Exception as e:
+                logger.warning(f"request {ticket.uid}: finish callback failed: {e!r}")
+
+    # -- stall watchdog ------------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Decode-progress watchdog: when the scheduler had work but no step
+        completed for ``stall_timeout_s`` (wedged device call, injected
+        ``serve_stall``, runaway compile), flip ``/healthz`` to 503 "stuck"
+        so the router ejects this replica, and dump the flight recorder once
+        per episode for offline triage.  Un-sticks by itself when a step
+        completes — a recovered replica goes back into rotation."""
+        interval = max(0.02, min(self.stall_timeout_s / 4.0, 1.0))
+        while not self.drained.is_set():
+            time.sleep(interval)
+            # _model_busy/_last_step_t freeze at their last values while the
+            # model thread is wedged — which is exactly the signal
+            stalled = (
+                self._model_busy
+                and time.monotonic() - self._last_step_t > self.stall_timeout_s
+            )
+            if stalled and not self._stuck:
+                self._stuck = True
+                self.stats.set_gauge("stuck", 1)
+                logger.error(
+                    f"watchdog: no decode step for {self.stall_timeout_s:.1f}s "
+                    "with work queued; healthz -> 503 stuck"
+                )
+                dump_on_fault("serve_stall")
+                if self.metrics is not None:
+                    self.metrics.event(
+                        "serve_stall_detected",
+                        stall_timeout_s=self.stall_timeout_s,
+                        active_slots=self.scheduler.active_slots,
+                    )
+            elif not stalled and self._stuck:
+                self._stuck = False
+                self.stats.set_gauge("stuck", 0)
+                logger.warning("watchdog: decode progress resumed; healthz -> ok")
 
     def _claim(self, ticket: Ticket) -> None:
         """Hand one admitted ticket to the scheduler (model thread only)."""
@@ -304,8 +442,11 @@ class GenerateServer:
             if index == 0:
                 self.stats.observe("ttft_seconds", now - _t.t_enqueue)
             elif _t.t_last_token is not None:
-                self.stats.observe("tpot_seconds", now - _t.t_last_token)
+                tpot = now - _t.t_last_token
+                self.stats.observe("tpot_seconds", tpot)
+                self.admission.note_tpot(tpot)  # feeds the Retry-After hint
             _t.t_last_token = now
+            self._tokens_emitted += 1
             self.stats.inc("tokens_generated_total")
             _t.on_token(uid, token, index)
 
@@ -355,6 +496,11 @@ class GenerateServer:
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        if faults.should("serve_accept_drop"):
+            # drill: an accepted connection that dies before a byte of
+            # response — the shape a router's pre-stream retry must absorb
+            self.stats.inc("accept_drops_total")
+            return
         try:
             parsed = await asyncio.wait_for(_read_http_request(reader), _REQUEST_TIMEOUT_S)
         except ValueError as e:
@@ -381,16 +527,31 @@ class GenerateServer:
             await _respond_json(writer, 404, {"error": f"no route {route}"})
 
     async def _handle_healthz(self, writer: asyncio.StreamWriter) -> None:
-        draining = self.admission.draining
-        status = 503 if draining else 200
+        # precedence: a dead worker trumps everything, a wedged worker trumps
+        # drain state — the router must stop routing on all three
+        if self._worker_error is not None:
+            state, status = "error", 503
+        elif self._stuck:
+            state, status = "stuck", 503
+        elif self.admission.draining:
+            state, status = "draining", 503
+        else:
+            state, status = "ok", 200
         payload = {
-            "status": "draining" if draining else "ok",
+            "status": state,
             "active_slots": self.scheduler.active_slots,
             "queue_depth": self.admission.depth() + self.scheduler.queue_depth,
             "max_batch": self.scheduler.max_batch,
             "max_queue": self.admission.max_queue,
+            "retry_after_s": round(self.admission.retry_after_s, 3),
             "uptime_s": round(time.monotonic() - self._t_start, 3),
         }
+        if self._worker_error is not None:
+            payload["detail"] = f"model thread died: {self._worker_error!r}"
+        elif self._stuck:
+            payload["detail"] = (
+                f"no decode step completed for {self.stall_timeout_s:.1f}s"
+            )
         # paged scheduler: pool pressure for the allocator-exhaustion triage
         # flow (docs/operations.md) — queued-but-healthy vs queued-and-starved
         paging_stats = getattr(self.scheduler, "paging_stats", None)
@@ -410,6 +571,17 @@ class GenerateServer:
         # threads through every phase span), otherwise one is minted here
         rid = ((headers or {}).get("x-request-id") or "").strip() or new_trace_id()
         rid_header = {"X-Request-Id": rid}
+        if self._worker_error is not None:
+            # dead worker, listener lingering for health probes: fail fast
+            # instead of queueing a ticket nothing will ever claim
+            self.stats.inc("rejected_total", ("reason", "error"))
+            await _respond_json(
+                writer,
+                500,
+                {"error": f"model thread died: {self._worker_error!r}"},
+                extra_headers=rid_header,
+            )
+            return
         try:
             fields = parse_generate_body(
                 body,
@@ -566,7 +738,7 @@ class GenerateServer:
                 if kind == "finish":
                     await _respond_json(
                         writer,
-                        200,
+                        500 if a.finish_reason == "error" else 200,
                         _completion_record(a),
                         extra_headers={"X-Request-Id": ticket.trace_id or ""},
                     )
@@ -580,96 +752,6 @@ class GenerateServer:
         thread frees its slot at the next step boundary."""
         ticket.cancelled.set()
         self.stats.inc("disconnects_total")
-
-
-# -- wire helpers ------------------------------------------------------------
-
-_REASONS = {
-    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
-    413: "Payload Too Large", 429: "Too Many Requests", 500: "Internal Server Error",
-    503: "Service Unavailable",
-}
-
-
-def _head(
-    status: int,
-    reason: str,
-    content_type: str,
-    extra: Optional[Dict[str, str]] = None,
-    content_length: Optional[int] = None,
-) -> bytes:
-    lines = [
-        f"HTTP/1.1 {status} {reason}",
-        f"Content-Type: {content_type}",
-        "Connection: close",
-    ]
-    if content_length is not None:
-        lines.append(f"Content-Length: {content_length}")
-    for k, v in (extra or {}).items():
-        lines.append(f"{k}: {v}")
-    return ("\r\n".join(lines) + "\r\n\r\n").encode()
-
-
-def _sse(obj: Dict[str, Any]) -> bytes:
-    return b"data: " + json.dumps(obj).encode() + b"\n\n"
-
-
-async def _respond(
-    writer: asyncio.StreamWriter,
-    status: int,
-    body: str,
-    *,
-    content_type: str = "text/plain",
-    extra_headers: Optional[Dict[str, str]] = None,
-) -> None:
-    payload = body.encode()
-    writer.write(
-        _head(status, _REASONS.get(status, "?"), content_type, extra_headers, len(payload))
-    )
-    writer.write(payload)
-    await writer.drain()
-
-
-async def _respond_json(
-    writer: asyncio.StreamWriter,
-    status: int,
-    obj: Dict[str, Any],
-    *,
-    extra_headers: Optional[Dict[str, str]] = None,
-) -> None:
-    await _respond(
-        writer,
-        status,
-        json.dumps(obj),
-        content_type="application/json",
-        extra_headers=extra_headers,
-    )
-
-
-async def _read_http_request(
-    reader: asyncio.StreamReader,
-) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
-    """Minimal HTTP/1.1 request parser: request line, headers, Content-Length
-    body.  Returns None on an empty connection (health-checker port probes)."""
-    line = await reader.readline()
-    if not line.strip():
-        return None
-    parts = line.decode("latin-1").split()
-    if len(parts) < 3:
-        raise ValueError(f"malformed request line: {line!r}")
-    method, target = parts[0].upper(), parts[1]
-    headers: Dict[str, str] = {}
-    while True:
-        raw = await reader.readline()
-        if raw in (b"\r\n", b"\n", b""):
-            break
-        key, _, value = raw.decode("latin-1").partition(":")
-        headers[key.strip().lower()] = value.strip()
-    length = int(headers.get("content-length", "0") or "0")
-    if length > _MAX_BODY_BYTES:
-        raise ValueError(f"body too large: {length} bytes")
-    body = await reader.readexactly(length) if length else b""
-    return method, target, headers, body
 
 
 def run_server(

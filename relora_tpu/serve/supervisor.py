@@ -1,0 +1,440 @@
+"""Process supervisor for the multi-replica serving tier.
+
+Spawns N replica processes (normally ``serve.py --port 0 --port-file ...``),
+restarts the ones that crash, and turns SIGTERM into a rolling drain.  Pure
+stdlib (subprocess + threading) and jax-free: this process must stay
+responsive while its children fight the accelerator.
+
+Restart policy:
+
+- **Crash-loop backoff.**  A replica that exits uncleanly is respawned
+  after ``min(backoff_base_s * 2^(consecutive-1), backoff_cap_s)`` plus up
+  to ``backoff_jitter`` relative jitter (so N replicas felled by one cause
+  do not respawn in lockstep).  A clean exit during a drain is not a crash.
+- **Quarantine.**  A replica that crashes ``quarantine_after`` times within
+  ``crash_window_s`` is quarantined: no further restarts, a loud log line,
+  and a ``quarantined`` flag in :meth:`status` — flapping is a bug to
+  diagnose (docs/operations.md has the runbook), not a loop to hide.
+- **Rolling drain.**  ``begin_rolling_drain()`` (wired to SIGTERM by the
+  CLI) SIGTERMs replicas one at a time, waiting for each to finish its
+  graceful drain (``serve_drain_complete``) before touching the next — the
+  router keeps serving from the others throughout, so a fleet SIGTERM
+  loses zero requests.
+
+Port discovery is file-based and restart-safe: each replica gets
+``--port 0 --port-file <workdir>/replica_<i>.port``; the supervisor deletes
+the port file before every (re)spawn and :meth:`endpoints` reports ``None``
+until the new incarnation has bound.  The router re-reads ``endpoints``
+every probe round, so a restarted replica's new ephemeral port is picked up
+automatically.  A ``replica_<i>.pid`` file is kept current for external
+drills (``kill -9 $(cat replica_0.pid)`` in scripts/smoke_test.sh).
+
+CLI — supervisor + router as one front-end process::
+
+    python -m relora_tpu.serve.supervisor --replicas 2 \\
+        --router-port 8000 --workdir /tmp/fleet -- \\
+        python serve.py --checkpoint ckpts/relora/model_20000 \\
+            --model_config llama_250m --max-batch 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple, Union
+
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: command: a base argv (the supervisor appends ``--port 0 --port-file ...``),
+#: or a callable ``(replica_idx, port_file) -> argv`` for full control
+ReplicaCommand = Union[Sequence[str], Callable[[int, str], Sequence[str]]]
+
+
+def backoff_delay(
+    consecutive: int,
+    *,
+    base_s: float = 0.5,
+    cap_s: float = 30.0,
+    jitter: float = 0.1,
+    rand: Callable[[], float] = random.random,
+) -> float:
+    """Exponential crash-loop backoff: ``min(base * 2^(n-1), cap)`` plus up
+    to ``jitter`` relative jitter.  ``consecutive`` is the crash streak
+    (>= 1)."""
+    delay = min(base_s * (2.0 ** max(consecutive - 1, 0)), cap_s)
+    return delay * (1.0 + jitter * rand())
+
+
+@dataclasses.dataclass
+class _Replica:
+    idx: int
+    port_file: str
+    pid_file: str
+    log_path: str
+    proc: Optional[subprocess.Popen] = None
+    log_fh: Optional[object] = None
+    restarts: int = 0
+    consecutive_crashes: int = 0
+    crash_times: Deque[float] = dataclasses.field(default_factory=deque)
+    restart_at: Optional[float] = None  # backoff deadline; None = not pending
+    quarantined: bool = False
+    draining: bool = False  # SIGTERM sent by a rolling drain; exit expected
+    last_exit_code: Optional[int] = None
+
+    @property
+    def rid(self) -> str:
+        return f"r{self.idx}"
+
+
+class ReplicaSupervisor:
+    """Spawn, watch, restart, quarantine, and drain N replica processes."""
+
+    def __init__(
+        self,
+        command: ReplicaCommand,
+        n_replicas: int,
+        workdir: str,
+        *,
+        backoff_base_s: float = 0.5,
+        backoff_cap_s: float = 30.0,
+        backoff_jitter: float = 0.1,
+        quarantine_after: int = 5,
+        crash_window_s: float = 120.0,
+        drain_timeout_s: float = 60.0,
+        poll_interval_s: float = 0.1,
+        env_overrides: Optional[Dict[int, Dict[str, str]]] = None,
+        env_overrides_respawn: bool = True,
+        on_event: Optional[Callable[[str, int, Dict], None]] = None,
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.command = command
+        self.workdir = workdir
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.backoff_jitter = backoff_jitter
+        self.quarantine_after = quarantine_after
+        self.crash_window_s = crash_window_s
+        self.drain_timeout_s = drain_timeout_s
+        self.poll_interval_s = poll_interval_s
+        # per-replica-index env on top of os.environ: how a drill arms a
+        # fault on one replica.  env_overrides_respawn=False applies them to
+        # the first incarnation only — crash once, come back clean, which is
+        # the "kill one replica under load" drill shape.
+        self.env_overrides = env_overrides or {}
+        self.env_overrides_respawn = env_overrides_respawn
+        self.on_event = on_event  # (event, replica_idx, detail) — tests hook this
+        os.makedirs(workdir, exist_ok=True)
+        self._replicas = [
+            _Replica(
+                idx=i,
+                port_file=os.path.join(workdir, f"replica_{i}.port"),
+                pid_file=os.path.join(workdir, f"replica_{i}.pid"),
+                log_path=os.path.join(workdir, f"replica_{i}.log"),
+            )
+            for i in range(n_replicas)
+        ]
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._draining = False
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for rep in self._replicas:
+            self._spawn(rep, first=True)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="supervisor", daemon=True
+        )
+        self._monitor.start()
+
+    def stop(self) -> None:
+        """Immediate teardown (test/bench cleanup): SIGKILL everything."""
+        self._stop.set()
+        with self._lock:
+            for rep in self._replicas:
+                if rep.proc is not None and rep.proc.poll() is None:
+                    rep.proc.kill()
+        for rep in self._replicas:
+            if rep.proc is not None:
+                try:
+                    rep.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            if rep.log_fh is not None:
+                rep.log_fh.close()
+                rep.log_fh = None
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+
+    def begin_rolling_drain(self) -> None:
+        """SIGTERM replicas one at a time, each graceful drain completing
+        before the next starts — the rest of the fleet keeps serving.
+        Blocks until every replica has exited (or drain_timeout_s forces a
+        kill); idempotent-ish: a second call finds nothing left to drain."""
+        with self._lock:
+            self._draining = True
+        logger.info("rolling drain: one replica at a time")
+        for rep in self._replicas:
+            with self._lock:
+                proc = rep.proc
+                if proc is None or proc.poll() is not None:
+                    continue
+                rep.draining = True
+            self._event("drain_begin", rep)
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=self.drain_timeout_s)
+            except subprocess.TimeoutExpired:
+                logger.error(
+                    f"replica {rep.rid}: drain exceeded {self.drain_timeout_s}s; killing"
+                )
+                proc.kill()
+                proc.wait(timeout=10.0)
+            self._remove_stale(rep)
+            self._event("drain_complete", rep, exit_code=proc.returncode)
+            logger.info(f"replica {rep.rid} drained (exit {proc.returncode})")
+        self._stop.set()
+
+    # -- the router's view ---------------------------------------------------
+
+    def endpoints(self) -> Dict[str, Tuple[str, Optional[int]]]:
+        """Live {rid: (host, port-or-None)} — port None while a replica is
+        down, restarting, or quarantined.  The router polls this every probe
+        round, so restarts (new ephemeral ports) propagate automatically."""
+        out: Dict[str, Tuple[str, Optional[int]]] = {}
+        for rep in self._replicas:
+            port: Optional[int] = None
+            if rep.proc is not None and rep.proc.poll() is None:
+                try:
+                    with open(rep.port_file) as f:
+                        port = int(f.read().strip())
+                except (OSError, ValueError):
+                    port = None  # not bound yet
+            out[rep.rid] = ("127.0.0.1", port)
+        return out
+
+    def status(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                rep.rid: {
+                    "pid": rep.proc.pid if rep.proc is not None else None,
+                    "running": rep.proc is not None and rep.proc.poll() is None,
+                    "restarts": rep.restarts,
+                    "consecutive_crashes": rep.consecutive_crashes,
+                    "quarantined": rep.quarantined,
+                    "draining": rep.draining,
+                    "last_exit_code": rep.last_exit_code,
+                }
+                for rep in self._replicas
+            }
+
+    def pid(self, idx: int) -> Optional[int]:
+        rep = self._replicas[idx]
+        return rep.proc.pid if rep.proc is not None else None
+
+    def send_signal(self, idx: int, sig: int) -> None:
+        """Deliver a signal to one replica (drills: SIGKILL under load)."""
+        rep = self._replicas[idx]
+        if rep.proc is not None and rep.proc.poll() is None:
+            rep.proc.send_signal(sig)
+
+    # -- internals -----------------------------------------------------------
+
+    def _event(self, event: str, rep: _Replica, **detail) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(event, rep.idx, detail)
+            except Exception:
+                pass
+
+    def _argv(self, rep: _Replica) -> List[str]:
+        if callable(self.command):
+            return list(self.command(rep.idx, rep.port_file))
+        return list(self.command) + ["--port", "0", "--port-file", rep.port_file]
+
+    def _remove_stale(self, rep: _Replica) -> None:
+        for path in (rep.port_file, rep.pid_file):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _spawn(self, rep: _Replica, *, first: bool) -> None:
+        self._remove_stale(rep)  # never route to a dead incarnation's port
+        env = dict(os.environ)
+        if first or self.env_overrides_respawn:
+            env.update(self.env_overrides.get(rep.idx, {}))
+        if rep.log_fh is None:
+            rep.log_fh = open(rep.log_path, "ab")
+        argv = self._argv(rep)
+        rep.proc = subprocess.Popen(
+            argv,
+            stdout=rep.log_fh,
+            stderr=subprocess.STDOUT,
+            env=env,
+            start_new_session=True,  # a fleet SIGTERM is ours to orchestrate
+        )
+        with open(rep.pid_file, "w") as f:
+            f.write(str(rep.proc.pid))
+        rep.restart_at = None
+        self._event("spawn" if first else "respawn", rep, pid=rep.proc.pid)
+        logger.info(f"replica {rep.rid}: pid {rep.proc.pid} ({' '.join(argv[:3])} ...)")
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.poll_interval_s)
+            with self._lock:
+                if self._draining:
+                    continue  # begin_rolling_drain owns the processes now
+                for rep in self._replicas:
+                    self._check(rep)
+
+    def _check(self, rep: _Replica) -> None:
+        now = time.monotonic()
+        if rep.quarantined:
+            return
+        if rep.restart_at is not None:
+            if now >= rep.restart_at:
+                rep.restarts += 1
+                self._spawn(rep, first=False)
+            return
+        proc = rep.proc
+        if proc is None or proc.poll() is None:
+            return
+        # the replica exited outside a drain: a crash
+        code = proc.returncode
+        rep.last_exit_code = code
+        self._remove_stale(rep)
+        rep.consecutive_crashes += 1
+        rep.crash_times.append(now)
+        while rep.crash_times and now - rep.crash_times[0] > self.crash_window_s:
+            rep.crash_times.popleft()
+        self._event("crash", rep, exit_code=code)
+        if len(rep.crash_times) >= self.quarantine_after:
+            rep.quarantined = True
+            rep.proc = None
+            self._event("quarantine", rep, crashes=len(rep.crash_times))
+            logger.error(
+                f"replica {rep.rid} QUARANTINED: {len(rep.crash_times)} crashes "
+                f"within {self.crash_window_s:.0f}s (last exit {code}) — "
+                "not restarting; see docs/operations.md (replica crash-looping)"
+            )
+            return
+        delay = backoff_delay(
+            rep.consecutive_crashes,
+            base_s=self.backoff_base_s,
+            cap_s=self.backoff_cap_s,
+            jitter=self.backoff_jitter,
+        )
+        rep.restart_at = now + delay
+        rep.proc = None
+        logger.warning(
+            f"replica {rep.rid} exited {code}; restart #{rep.restarts + 1} "
+            f"in {delay:.2f}s (crash streak {rep.consecutive_crashes})"
+        )
+
+    def note_healthy(self, idx: int) -> None:
+        """Optional: callers that know a replica is serving again (e.g. the
+        CLI watching router health) can clear its crash streak so an
+        occasional crash every few hours never accumulates to quarantine."""
+        rep = self._replicas[idx]
+        with self._lock:
+            rep.consecutive_crashes = 0
+
+
+# -- CLI: supervisor + router in one front-end process -----------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Run N serve.py replicas behind the health-aware router.",
+        epilog="Everything after '--' is the replica command; the supervisor "
+        "appends --port 0 --port-file <workdir>/replica_<i>.port to it.",
+    )
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--workdir", required=True, help="port/pid/log files live here")
+    p.add_argument("--router-host", default="127.0.0.1")
+    p.add_argument("--router-port", type=int, default=8000, help="0 = ephemeral")
+    p.add_argument("--router-port-file", default=None)
+    p.add_argument("--backoff-base-s", type=float, default=0.5)
+    p.add_argument("--backoff-cap-s", type=float, default=30.0)
+    p.add_argument("--quarantine-after", type=int, default=5)
+    p.add_argument("--crash-window-s", type=float, default=120.0)
+    p.add_argument("--drain-timeout-s", type=float, default=60.0)
+    p.add_argument("--probe-interval-s", type=float, default=0.25)
+    p.add_argument(
+        "command", nargs=argparse.REMAINDER, help="replica command (after --)"
+    )
+    args = p.parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        raise SystemExit("pass the replica command after '--'")
+
+    from relora_tpu.serve.router import Router  # jax-free, like this module
+
+    sup = ReplicaSupervisor(
+        command,
+        args.replicas,
+        args.workdir,
+        backoff_base_s=args.backoff_base_s,
+        backoff_cap_s=args.backoff_cap_s,
+        quarantine_after=args.quarantine_after,
+        crash_window_s=args.crash_window_s,
+        drain_timeout_s=args.drain_timeout_s,
+    )
+    router = Router(
+        sup.endpoints,
+        host=args.router_host,
+        port=args.router_port,
+        probe_interval_s=args.probe_interval_s,
+    )
+    sup.start()
+
+    def on_sigterm(signum, frame):
+        logger.info("SIGTERM: rolling drain, then router shutdown")
+
+        def _drain():
+            sup.begin_rolling_drain()
+            router.begin_shutdown()
+
+        threading.Thread(target=_drain, name="rolling-drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    signal.signal(signal.SIGINT, on_sigterm)
+
+    import asyncio
+
+    async def _main() -> None:
+        serve = asyncio.ensure_future(router.serve_forever())
+        while not router.started.is_set():
+            await asyncio.sleep(0.01)
+            if serve.done():
+                break
+        if args.router_port_file and not serve.done():
+            with open(args.router_port_file, "w") as f:
+                f.write(str(router.port))
+        await serve
+
+    try:
+        asyncio.run(_main())
+    finally:
+        sup.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""Minimal HTTP/1.1 wire helpers shared by the serving tier.
+
+Extracted from serve/server.py so the router and supervisor — which run in
+front-end processes that must never pay a jax import — can speak the same
+wire format as the replicas.  Stdlib-only (asyncio + json), like
+serve/admission.py: everything here must import fast and run anywhere the
+linter runs.
+
+The dialect is deliberately tiny: HTTP/1.1, ``Connection: close`` on every
+response, ``Content-Length`` bodies on requests, close-delimited bodies on
+streaming responses.  This is the subset the stdlib-asyncio server and the
+raw-socket test/bench clients have always used; keeping it in one place is
+what lets the router proxy byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional, Tuple
+
+MAX_BODY_BYTES = 16 << 20
+
+REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    413: "Payload Too Large", 429: "Too Many Requests", 500: "Internal Server Error",
+    502: "Bad Gateway", 503: "Service Unavailable",
+}
+
+
+def head(
+    status: int,
+    reason: str,
+    content_type: str,
+    extra: Optional[Dict[str, str]] = None,
+    content_length: Optional[int] = None,
+) -> bytes:
+    lines = [
+        f"HTTP/1.1 {status} {reason}",
+        f"Content-Type: {content_type}",
+        "Connection: close",
+    ]
+    if content_length is not None:
+        lines.append(f"Content-Length: {content_length}")
+    for k, v in (extra or {}).items():
+        lines.append(f"{k}: {v}")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode()
+
+
+def sse(obj: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+
+async def respond(
+    writer: asyncio.StreamWriter,
+    status: int,
+    body: str,
+    *,
+    content_type: str = "text/plain",
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> None:
+    payload = body.encode()
+    writer.write(
+        head(status, REASONS.get(status, "?"), content_type, extra_headers, len(payload))
+    )
+    writer.write(payload)
+    await writer.drain()
+
+
+async def respond_json(
+    writer: asyncio.StreamWriter,
+    status: int,
+    obj: Dict[str, Any],
+    *,
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> None:
+    await respond(
+        writer,
+        status,
+        json.dumps(obj),
+        content_type="application/json",
+        extra_headers=extra_headers,
+    )
+
+
+async def read_http_request(
+    reader: asyncio.StreamReader,
+) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+    """Minimal HTTP/1.1 request parser: request line, headers, Content-Length
+    body.  Returns None on an empty connection (health-checker port probes)."""
+    line = await reader.readline()
+    if not line.strip():
+        return None
+    parts = line.decode("latin-1").split()
+    if len(parts) < 3:
+        raise ValueError(f"malformed request line: {line!r}")
+    method, target = parts[0].upper(), parts[1]
+    headers: Dict[str, str] = {}
+    while True:
+        raw = await reader.readline()
+        if raw in (b"\r\n", b"\n", b""):
+            break
+        key, _, value = raw.decode("latin-1").partition(":")
+        headers[key.strip().lower()] = value.strip()
+    length = int(headers.get("content-length", "0") or "0")
+    if length > MAX_BODY_BYTES:
+        raise ValueError(f"body too large: {length} bytes")
+    body = await reader.readexactly(length) if length else b""
+    return method, target, headers, body

@@ -21,6 +21,20 @@ host-side calls that are no-ops until a fault is armed:
   poisons its gradients with NaN (compiled statically into the step by the
   Trainer); exercises the NaN gate and its counter persistence.
 
+Serving sites (hooked by ``serve/server.py``, drilled in
+``tests/test_router.py`` / ``tests/test_server.py``):
+
+- ``serve_tick(tokens)``          — called by the model thread once per
+  decode-loop iteration with the cumulative sampled-token count; drives
+  ``serve_stall`` (``sleep_s=S,at_token=N`` — block the decode loop so the
+  watchdog trips), ``serve_decode`` (``exc=...,at_token=N`` — raise on the
+  model thread, the worker-death path), and ``serve_crash``
+  (``at_token=N,code=C`` — ``os._exit``, the kill -9-shaped crash the
+  supervisor must absorb).
+- ``should("serve_accept_drop")`` — non-raising boolean variant of
+  ``maybe_fail``: the server closes the first ``times`` accepted
+  connections without a byte of response (router retry drill).
+
 Configuration is programmatic (``configure``/``reset``, used by tests) or
 via the ``RELORA_TPU_FAULTS`` env var for CLI runs, e.g.::
 
@@ -33,6 +47,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Any, Optional
 
 from relora_tpu.utils.logging import get_logger
@@ -46,6 +61,8 @@ _EXC_NAMES = {
     "oserror": OSError,
     "ioerror": IOError,
     "timeout": TimeoutError,
+    "connectionerror": ConnectionError,
+    "runtimeerror": RuntimeError,
 }
 
 
@@ -73,6 +90,76 @@ def active(site: Optional[str] = None) -> bool:
 
 def fire_count(site: str) -> int:
     return _FIRED.get(site, 0)
+
+
+def should(site: str) -> bool:
+    """Non-raising variant of ``maybe_fail``: True for the first ``times``
+    calls at an armed site.  For drop/skip-style faults (e.g. the server
+    closing an accepted connection unanswered) where raising would take the
+    wrong code path."""
+    spec = _FAULTS.get(site)
+    if spec is None:
+        return False
+    times = int(spec.get("times", 1))
+    if _FIRED.get(site, 0) >= times:
+        return False
+    _FIRED[site] = _FIRED.get(site, 0) + 1
+    logger.warning(f"fault fired: {site!r} ({_FIRED[site]}/{times})")
+    return True
+
+
+def serve_tick(tokens: int) -> None:
+    """Serving-side fault sites, called by the server's model thread once
+    per decode-loop iteration with the cumulative sampled-token count.
+    Each site triggers once ``tokens`` reaches its ``at_token`` (default 0,
+    i.e. the first iteration), at most ``times`` times (default 1):
+
+    - ``serve_stall``  — ``time.sleep(sleep_s)`` on the model thread; the
+      event loop stays live but decode makes no progress, so the stall
+      watchdog must trip and ``/healthz`` must flip to 503 "stuck".
+    - ``serve_decode`` — raise ``exc``; exercises the worker-death path
+      (all tickets failed with ``finish_reason="error"``, healthz 503).
+    - ``serve_crash``  — ``os._exit(code)`` (default 13): the process dies
+      without cleanup, exactly like a kill -9 or an XLA abort; exercises
+      supervisor restart + router failover against a real child.
+    """
+    spec = _FAULTS.get("serve_stall")
+    if spec is not None and tokens >= int(spec.get("at_token", 0)):
+        times = int(spec.get("times", 1))
+        if _FIRED.get("serve_stall", 0) < times:
+            _FIRED["serve_stall"] = _FIRED.get("serve_stall", 0) + 1
+            sleep_s = float(spec.get("sleep_s", 1.0))
+            logger.warning(f"fault serve_stall: blocking decode for {sleep_s}s")
+            time.sleep(sleep_s)
+    spec = _FAULTS.get("serve_decode")
+    if spec is not None and tokens >= int(spec.get("at_token", 0)):
+        times = int(spec.get("times", 1))
+        if _FIRED.get("serve_decode", 0) < times:
+            _FIRED["serve_decode"] = _FIRED.get("serve_decode", 0) + 1
+            exc = spec.get("exc", RuntimeError)
+            raise exc(f"injected fault at 'serve_decode' (token {tokens})")
+    spec = _FAULTS.get("serve_crash")
+    if spec is not None and tokens >= int(spec.get("at_token", 0)):
+        if _FIRED.get("serve_crash", 0) < int(spec.get("times", 1)):
+            _FIRED["serve_crash"] = _FIRED.get("serve_crash", 0) + 1
+            code = int(spec.get("code", 13))
+            logger.warning(f"fault serve_crash: os._exit({code}) at token {tokens}")
+            os._exit(code)
+
+
+def summary() -> str:
+    """One-line description of every armed fault — logged at server boot so
+    a drill can never be mistaken for a production incident."""
+    if not _FAULTS:
+        return "faults: none armed"
+    parts = []
+    for site in sorted(_FAULTS):
+        spec = _FAULTS[site]
+        kv = ",".join(
+            f"{k}={getattr(v, '__name__', v)}" for k, v in sorted(spec.items(), key=lambda i: i[0])
+        )
+        parts.append(f"{site}:{kv}" if kv else site)
+    return "FAULTS ARMED (drill, not production): " + "; ".join(parts)
 
 
 def maybe_fail(site: str) -> None:
@@ -153,9 +240,9 @@ def configure_from_env(env: Optional[str] = None) -> None:
                 )
             elif k == "exc":
                 spec["exc"] = _EXC_NAMES.get(v.lower(), OSError)
-            elif k in ("times", "at", "sig"):
+            elif k in ("times", "at", "sig", "at_token", "code"):
                 spec[k] = int(v)
-            elif k == "delta":
+            elif k in ("delta", "sleep_s"):
                 spec[k] = float(v)
             else:
                 logger.warning(f"unknown fault spec key {k!r} in {part!r}; ignored")

@@ -225,4 +225,102 @@ grep -q "steady-state retraces: 0" "$WORK/perf_report.txt"
 # because CPU numbers swing with machine load
 python tools/bench_gate.py --check --warn-only
 
+echo "=== 12. multi-replica fleet: supervisor + router, SIGKILL failover, rolling drain ==="
+FLEET="$WORK/fleet"
+rm -rf "$FLEET"; mkdir -p "$FLEET"
+rm -f "$WORK/router_port"
+# two serve.py replicas behind the health-aware router, one front-end process;
+# the supervisor appends --port 0 --port-file <workdir>/replica_<i>.port
+python -m relora_tpu.serve.supervisor --replicas 2 --workdir "$FLEET" \
+    --router-port 0 --router-port-file "$WORK/router_port" \
+    --backoff-base-s 0.2 --probe-interval-s 0.1 -- \
+    python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --max-batch 2 --max-queue 8 --cache-size 64 --eos-id -1 &
+SUP_PID=$!
+for _ in $(seq 600); do [ -s "$WORK/router_port" ] && break; sleep 0.2; done
+[ -s "$WORK/router_port" ] || { echo "router never wrote its port"; kill "$SUP_PID"; exit 1; }
+python - "$(cat "$WORK/router_port")" "$FLEET" <<'EOF'
+import json, os, signal, sys, time, urllib.error, urllib.request
+
+port, fleet = sys.argv[1], sys.argv[2]
+base = f"http://127.0.0.1:{port}"
+
+def healthz():
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:  # 503 while < 1 replica routable
+        return json.loads(e.read().decode())
+
+def wait_healthy(n, tries=600):
+    h = {}
+    for _ in range(tries):
+        h = healthz()
+        if h.get("healthy_replicas", 0) >= n:
+            return h
+        time.sleep(0.2)
+    raise SystemExit(f"fleet never reached {n} healthy replicas: {h}")
+
+def stream(max_new_tokens, kill_mid_stream=False):
+    """One /v1/generate stream through the router -> (replica_id, events)."""
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": max_new_tokens}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        rid = resp.headers["X-Relora-Replica"]
+        events = []
+        for line in resp:
+            if not line.startswith(b"data: "):
+                continue
+            events.append(line[len(b"data: "):].strip())
+            if kill_mid_stream and len(events) == 1:
+                pid = int(open(os.path.join(fleet, f"replica_{rid[1:]}.pid")).read())
+                os.kill(pid, signal.SIGKILL)
+    return rid, events
+
+wait_healthy(2)
+# warm both replicas (a replica's first request compiles the decode graph);
+# equal-load ties round-robin, so a few sequential streams cover the fleet
+seen = set()
+for _ in range(8):
+    rid, events = stream(4)
+    assert events[-1] == b"[DONE]", events
+    seen.add(rid)
+    if len(seen) == 2:
+        break
+assert len(seen) == 2, f"router never spread load across both replicas: {seen}"
+
+# SIGKILL the serving replica mid-stream: bytes already reached the client, so
+# no silent replay — the stream must end with a typed error, never a hang
+victim, events = stream(32, kill_mid_stream=True)
+if events[-1] == b"[DONE]":
+    print("note: victim finished its stream before the SIGKILL landed")
+else:
+    err = json.loads(events[-1]).get("error", {})
+    assert err.get("type") == "stream_interrupted", events[-3:]
+    assert err.get("retryable") is False, err
+
+# the survivor keeps serving while the victim restarts
+other, events = stream(4)
+assert other != victim and events[-1] == b"[DONE]", (other, victim, events[-3:])
+
+# the supervisor restarts the victim and the router routes to it again
+wait_healthy(2)
+for _ in range(60):
+    got, events = stream(4)
+    assert events[-1] == b"[DONE]", events
+    if got == victim:
+        break
+else:
+    raise SystemExit(f"restarted replica {victim} never served traffic again")
+
+metrics = urllib.request.urlopen(f"{base}/metrics", timeout=30).read().decode()
+assert "relora_router_healthy_replicas 2" in metrics, metrics
+assert "relora_router_requests_total" in metrics, metrics
+print(f"router failover OK: {victim} killed mid-stream, restarted, serving again")
+EOF
+kill -TERM "$SUP_PID"
+wait "$SUP_PID"   # exit 0 = rolling drain + router shutdown completed cleanly
+
 echo "SMOKE OK"

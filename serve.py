@@ -41,7 +41,14 @@ import sys
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--checkpoint", required=True, help="model_{step} checkpoint dir")
+    p.add_argument("--checkpoint", default=None, help="model_{step} checkpoint dir")
+    p.add_argument(
+        "--random-init",
+        action="store_true",
+        help="serve randomly initialized weights instead of a checkpoint "
+        "(load/fault drills and the bench harness; garbage tokens, real serving "
+        "path)",
+    )
     p.add_argument(
         "--model_config",
         required=True,
@@ -65,6 +72,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-queue", type=int, default=64, help="server: max waiting requests before 429")
     p.add_argument("--port-file", default=None, help="server: write the bound port here once listening")
     p.add_argument("--no-warmup", action="store_true", help="server: skip compile warmup at startup")
+    p.add_argument(
+        "--stall-timeout-s",
+        type=float,
+        default=0.0,
+        help="server: decode-progress watchdog — no scheduler step for this "
+        "long flips /healthz to 503 'stuck' and dumps the flight recorder "
+        "(0 disables; set it above your worst cold compile, or warm up first)",
+    )
     p.add_argument(
         "--paged",
         action="store_true",
@@ -120,6 +135,12 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     logger = get_logger("relora_tpu.serve")
 
+    from relora_tpu.utils import faults
+
+    if faults.active():
+        # a drill must never be mistaken for production: say so, loudly, once
+        logger.warning(faults.summary())
+
     if args.prompt and args.input_file:
         raise SystemExit(
             "--prompt and --input-file are mutually exclusive: one-shot mode "
@@ -144,18 +165,36 @@ def main(argv=None) -> int:
     )
 
     model_cfg = load_model_config(args.model_config)
-    logger.info(f"restoring {args.checkpoint}")
     lora_spec = None
-    if args.no_merge:
-        lora_spec = load_lora_spec(args.checkpoint)
-        if lora_spec is None:
-            raise SystemExit(
-                f"--no-merge: {args.checkpoint} has no relora_config.json sidecar "
-                "(full-rank checkpoint? drop the flag)"
-            )
-        params = restore_params_host(args.checkpoint)
+    if args.random_init:
+        if args.checkpoint or args.no_merge:
+            raise SystemExit("--random-init excludes --checkpoint/--no-merge")
+        import jax
+
+        from relora_tpu.models.params_util import init_params
+        from relora_tpu.serve.engine import build_decode_model
+
+        logger.info(f"random-init weights for {args.model_config} (drill/bench mode)")
+        model = build_decode_model(
+            model_cfg, cache_size=args.cache_size or model_cfg.max_sequence_length
+        )
+        params = init_params(
+            model, jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
+        )
+    elif args.checkpoint is None:
+        raise SystemExit("pass --checkpoint (or --random-init for drills)")
     else:
-        params = restore_serving_params(args.checkpoint)
+        logger.info(f"restoring {args.checkpoint}")
+        if args.no_merge:
+            lora_spec = load_lora_spec(args.checkpoint)
+            if lora_spec is None:
+                raise SystemExit(
+                    f"--no-merge: {args.checkpoint} has no relora_config.json sidecar "
+                    "(full-rank checkpoint? drop the flag)"
+                )
+            params = restore_params_host(args.checkpoint)
+        else:
+            params = restore_serving_params(args.checkpoint)
 
     import jax
 
@@ -243,6 +282,7 @@ def main(argv=None) -> int:
             default_max_new_tokens=args.max_new_tokens,
             default_temperature=args.temperature,
             default_top_p=args.top_p,
+            stall_timeout_s=args.stall_timeout_s,
             metrics=metrics,
             ready_cb=ready,
         )

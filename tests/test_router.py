@@ -1,0 +1,809 @@
+"""Multi-replica serving drills: supervisor, health-aware router, failover.
+
+Three layers of coverage, cheapest first:
+
+- **Unit**: the CircuitBreaker state machine on a fake clock and the
+  supervisor's backoff schedule — pure functions of time, no processes.
+- **Fake replicas**: the Router proxying to in-process asyncio stubs whose
+  behavior is switchable at runtime (healthy / 500s / drop-before-byte /
+  die-mid-stream), pinning least-loaded routing, the pre-stream retry
+  boundary, the typed mid-stream error, and the circuit lifecycle without
+  paying a jax import.
+- **Real children**: the supervisor restarting genuinely crashing processes
+  (quarantine after a crash loop, rolling-drain sequencing), and the
+  acceptance drill — a 2-replica ``serve.py --random-init`` fleet behind the
+  router where one replica ``os._exit``s mid-decode (``serve_crash`` fault)
+  under concurrent load: every accepted request must terminate (finish
+  record or typed error, none hung), the supervisor must restart the dead
+  replica, and traffic must return to it once its circuit closes.
+
+The subprocess fleet is module-scoped: ~10s per replica incarnation
+(jax import + tiny-model compile on CPU) is paid once, and the crash /
+recovery / rolling-drain tests share it in file order (tier-1 runs with
+``-p no:randomly``).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from relora_tpu.serve.router import CircuitBreaker, Router
+from relora_tpu.serve.supervisor import ReplicaSupervisor, backoff_delay
+
+pytestmark = [pytest.mark.serve, pytest.mark.faults]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- unit: fault-spec parsing for the serving sites ---------------------------
+
+
+def test_faults_env_parsing_and_boot_summary():
+    """The serving drills are armed through RELORA_TPU_FAULTS: int keys
+    (at_token, code), float keys (sleep_s), exception names incl.
+    connectionerror — and summary() renders one loud boot line."""
+    from relora_tpu.utils import faults
+
+    faults.reset()
+    try:
+        assert faults.summary() == "faults: none armed"
+        faults.configure_from_env(
+            "serve_crash:at_token=40,code=13;"
+            "serve_stall:sleep_s=0.01,times=2;"
+            "serve_decode:exc=connectionerror"
+        )
+        assert faults.active("serve_crash")
+        line = faults.summary()
+        assert line.startswith("FAULTS ARMED (drill, not production): ")
+        assert "serve_crash:at_token=40,code=13" in line
+        assert "serve_stall:sleep_s=0.01,times=2" in line
+        assert "serve_decode:exc=ConnectionError" in line
+        # the armed specs carry the parsed types, not strings
+        with pytest.raises(ConnectionError):
+            faults.serve_tick(0)
+        faults.reset()
+        assert faults.summary() == "faults: none armed"
+    finally:
+        faults.reset()
+
+
+# -- unit: breaker + backoff --------------------------------------------------
+
+
+def test_circuit_breaker_lifecycle():
+    """closed -> open after N consecutive failures; open -> half_open after
+    the cooldown with exactly one trial; failed trial doubles the cooldown;
+    a success closes and resets."""
+    clock = [0.0]
+    br = CircuitBreaker(
+        failure_threshold=3, cooldown_s=1.0, cooldown_max_s=4.0, clock=lambda: clock[0]
+    )
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()  # under threshold
+    br.record_failure()
+    assert br.state == "open" and br.opens_total == 1
+    assert not br.allow()  # cooldown not elapsed
+    clock[0] = 1.0
+    assert br.allow()  # the half-open trial
+    assert br.state == "half_open"
+    assert not br.allow()  # only one trial at a time
+    br.record_failure()  # trial failed: reopen, cooldown doubles
+    assert br.state == "open" and br.opens_total == 2
+    clock[0] = 2.5
+    assert not br.allow()  # doubled cooldown (2s from t=1) not elapsed
+    clock[0] = 3.0
+    assert br.allow() and br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed" and br.failures == 0
+    # cooldown reset: a fresh open waits cooldown_s again, not the doubled one
+    br.record_failure(), br.record_failure(), br.record_failure()
+    assert br.state == "open"
+    clock[0] = 4.0
+    assert br.allow()
+
+
+def test_backoff_delay_schedule():
+    """min(base * 2^(n-1), cap), plus bounded relative jitter."""
+    no_jitter = dict(base_s=0.5, cap_s=8.0, jitter=0.0)
+    assert [backoff_delay(n, **no_jitter) for n in (1, 2, 3, 4, 5, 6)] == [
+        0.5, 1.0, 2.0, 4.0, 8.0, 8.0  # capped
+    ]
+    # jitter is relative and one-sided: delay * (1 + jitter * U[0,1))
+    hi = backoff_delay(2, base_s=0.5, cap_s=8.0, jitter=0.2, rand=lambda: 1.0)
+    assert hi == pytest.approx(1.2)
+    assert backoff_delay(2, base_s=0.5, cap_s=8.0, jitter=0.2, rand=lambda: 0.0) == 1.0
+
+
+# -- supervisor with real (non-jax) children ---------------------------------
+
+
+def test_supervisor_crash_loop_backoff_then_quarantine(tmp_path):
+    """A replica that keeps crashing is respawned with backoff, then
+    quarantined after ``quarantine_after`` crashes inside the window — and
+    never respawned again."""
+    events = []
+    lock = threading.Lock()
+
+    def on_event(event, idx, detail):
+        with lock:
+            events.append((event, idx, dict(detail)))
+
+    sup = ReplicaSupervisor(
+        lambda idx, port_file: [sys.executable, "-c", "import sys; sys.exit(3)"],
+        1,
+        str(tmp_path),
+        backoff_base_s=0.02,
+        backoff_cap_s=0.1,
+        backoff_jitter=0.0,
+        quarantine_after=3,
+        crash_window_s=60.0,
+        poll_interval_s=0.01,
+        on_event=on_event,
+    )
+    sup.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if sup.status()["r0"]["quarantined"]:
+                break
+            time.sleep(0.02)
+        st = sup.status()["r0"]
+        assert st["quarantined"], f"never quarantined: {st}, events={events}"
+        assert st["last_exit_code"] == 3
+        with lock:
+            names = [e for e, _, _ in events]
+        # 1 spawn + 2 respawns = 3 crashes = quarantine_after
+        assert names.count("spawn") == 1
+        assert names.count("respawn") == 2
+        assert names.count("crash") == 3
+        assert names.count("quarantine") == 1
+        # quarantine is terminal: no further respawn ever happens
+        time.sleep(0.3)
+        with lock:
+            assert [e for e, _, _ in events].count("respawn") == 2
+        assert sup.endpoints()["r0"] == ("127.0.0.1", None)
+    finally:
+        sup.stop()
+
+
+_DRAINABLE_CHILD = """
+import os, signal, sys, time
+out, port_file = sys.argv[1], sys.argv[2]
+def on_term(sig, frame):
+    with open(out, "w") as fh:
+        fh.write(repr(time.time()))
+    time.sleep(0.3)  # a graceful drain takes time
+    sys.exit(0)
+signal.signal(signal.SIGTERM, on_term)
+with open(port_file, "w") as fh:
+    fh.write("1")  # pretend-bind so endpoints() sees us
+while True:
+    time.sleep(0.05)
+"""
+
+
+def test_supervisor_rolling_drain_is_sequential(tmp_path):
+    """begin_rolling_drain SIGTERMs one replica at a time, waiting for each
+    graceful exit before touching the next; clean drain exits are not
+    counted as crashes."""
+    events = []
+
+    def on_event(event, idx, detail):
+        events.append((event, idx, dict(detail)))
+
+    ts_files = [str(tmp_path / f"term_{i}.ts") for i in range(2)]
+    sup = ReplicaSupervisor(
+        lambda idx, port_file: [
+            sys.executable, "-c", _DRAINABLE_CHILD, ts_files[idx], port_file
+        ],
+        2,
+        str(tmp_path),
+        poll_interval_s=0.02,
+        drain_timeout_s=10.0,
+        on_event=on_event,
+    )
+    sup.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            eps = sup.endpoints()
+            if all(port is not None for _, port in eps.values()):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"children never bound: {sup.endpoints()}")
+        sup.begin_rolling_drain()
+
+        t_term = [float(open(f).read()) for f in ts_files]
+        # each child sleeps 0.3s after SIGTERM: strictly sequential drains
+        # put the second SIGTERM >= 0.3s after the first
+        assert t_term[1] - t_term[0] >= 0.25, f"drain overlapped: {t_term}"
+        names = [e for e, _, _ in events]
+        assert names.count("drain_begin") == 2
+        assert names.count("drain_complete") == 2
+        assert "crash" not in names, f"clean drain counted as crash: {events}"
+        drains = [d for e, _, d in events if e == "drain_complete"]
+        assert all(d["exit_code"] == 0 for d in drains), drains
+        st = sup.status()
+        assert not st["r0"]["running"] and not st["r1"]["running"]
+    finally:
+        sup.stop()
+
+
+# -- fake replicas: router behavior without jax -------------------------------
+
+
+class _FakeReplica:
+    """A switchable stand-in for one serve.py process: answers /healthz and
+    /v1/generate on a real socket, with failure modes a test flips at
+    runtime (``mode`` = ok | http500 | drop | die_midstream; ``alive``
+    gates /healthz)."""
+
+    def __init__(self, *, n_events=3, queue_depth=0):
+        self.mode = "ok"
+        self.alive = True  # healthz 200 vs 503
+        self.n_events = n_events
+        self.queue_depth = queue_depth
+        self.gen_hits = 0
+        self.port = None
+        self._started = threading.Event()
+        self._loop = None
+        self._stop = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10), "fake replica failed to start"
+
+    def _run(self):
+        asyncio.run(self._main())
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with server:
+            await self._stop.wait()
+
+    def close(self):
+        if self._loop is not None and self._stop is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._stop.set)
+            except RuntimeError:
+                pass
+        self._thread.join(10)
+
+    async def _handle(self, reader, writer):
+        try:
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    return
+                data += chunk
+            head, _, rest = data.partition(b"\r\n\r\n")
+            request_line = head.split(b"\r\n")[0].decode()
+            clen = 0
+            for line in head.split(b"\r\n")[1:]:
+                k, _, v = line.decode().partition(":")
+                if k.strip().lower() == "content-length":
+                    clen = int(v.strip())
+            while len(rest) < clen:
+                rest += await reader.read(4096)
+            if "/healthz" in request_line:
+                await self._respond_healthz(writer)
+            else:
+                await self._respond_generate(writer)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _respond_healthz(self, writer):
+        if self.alive:
+            code, payload = 200, {
+                "status": "ok",
+                "queue_depth": self.queue_depth,
+                "active_slots": 0,
+            }
+        else:
+            code, payload = 503, {"status": "stuck"}
+        body = json.dumps(payload).encode()
+        writer.write(
+            f"HTTP/1.1 {code} X\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+            + body
+        )
+        await writer.drain()
+
+    async def _respond_generate(self, writer):
+        self.gen_hits += 1
+        if self.mode == "drop":
+            return  # close with zero response bytes (accept-drop shape)
+        if self.mode == "http500":
+            body = json.dumps({"error": "injected"}).encode()
+            writer.write(
+                f"HTTP/1.1 500 X\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n\r\n"
+        )
+        await writer.drain()
+        upto = 2 if self.mode == "die_midstream" else self.n_events
+        for i in range(upto):
+            writer.write(
+                f"data: {json.dumps({'uid': 0, 'index': i, 'token': i + 1})}\n\n".encode()
+            )
+            await writer.drain()
+        if self.mode == "die_midstream":
+            return  # EOF without a finish record or [DONE]
+        final = {"uid": 0, "finish_reason": "length", "tokens": list(range(1, upto + 1))}
+        writer.write(f"data: {json.dumps(final)}\n\ndata: [DONE]\n\n".encode())
+        await writer.drain()
+
+
+class _RouterHarness:
+    """Run a Router over the given endpoints in a background thread."""
+
+    def __init__(self, endpoints, **kwargs):
+        self.router = Router(endpoints, port=0, **kwargs)
+        self.thread = threading.Thread(
+            target=lambda: asyncio.run(self.router.serve_forever()), daemon=True
+        )
+
+    def __enter__(self) -> Router:
+        self.thread.start()
+        assert self.router.started.wait(10), "router failed to start"
+        return self.router
+
+    def __exit__(self, *exc):
+        self.router.begin_shutdown()
+        self.thread.join(10)
+        assert not self.thread.is_alive(), "router did not shut down"
+
+    def wait_healthy(self, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if sum(st.healthy for st in self.router.replicas.values()) >= n:
+                return
+            time.sleep(0.02)
+        states = {r: st.status for r, st in self.router.replicas.items()}
+        pytest.fail(f"router never saw {n} healthy replicas: {states}")
+
+
+def _http(port, method, path, body=None, timeout=30.0):
+    payload = b"" if body is None else json.dumps(body).encode()
+    req = (
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n"
+    ).encode() + payload
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as sock:
+        sock.sendall(req)
+        data = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    head, _, rest = data.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split(b" ", 2)[1])
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, rest
+
+
+def _sse_events(body: bytes):
+    events = []
+    for block in body.decode().split("\n\n"):
+        block = block.strip()
+        if not block.startswith("data: "):
+            continue
+        payload = block[len("data: "):]
+        events.append("[DONE]" if payload == "[DONE]" else json.loads(payload))
+    return events
+
+
+def test_router_proxies_and_prefers_less_loaded(tmp_path):
+    """Streams proxy through whole (events + finish + [DONE], with the
+    X-Relora-Replica header); a replica reporting queue depth is avoided
+    while an idle sibling exists."""
+    a, b = _FakeReplica(), _FakeReplica()
+    harness = _RouterHarness(
+        {"a": ("127.0.0.1", a.port), "b": ("127.0.0.1", b.port)},
+        probe_interval_s=0.05,
+    )
+    try:
+        with harness as router:
+            harness.wait_healthy(2)
+            status, headers, body = _http(
+                router.port, "POST", "/v1/generate",
+                {"prompt": [1], "max_new_tokens": 3},
+            )
+            assert status == 200
+            assert headers["x-relora-replica"] in ("a", "b")
+            events = _sse_events(body)
+            assert events[-1] == "[DONE]"
+            assert events[-2]["finish_reason"] == "length"
+            assert [e["token"] for e in events[:-2]] == [1, 2, 3]
+
+            # load-aware: b reports a deep queue -> everything goes to a
+            b.queue_depth = 50
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if router.replicas["b"].load() >= 50:
+                    break
+                time.sleep(0.02)
+            before_a, before_b = a.gen_hits, b.gen_hits
+            for _ in range(4):
+                status, headers, _ = _http(
+                    router.port, "POST", "/v1/generate",
+                    {"prompt": [1], "max_new_tokens": 2},
+                )
+                assert status == 200 and headers["x-relora-replica"] == "a"
+            assert a.gen_hits == before_a + 4 and b.gen_hits == before_b
+
+            # aggregated views
+            status, _, body = _http(router.port, "GET", "/healthz")
+            health = json.loads(body)
+            assert status == 200 and health["status"] == "ok"
+            assert health["healthy_replicas"] == 2
+            assert set(health["replicas"]) == {"a", "b"}
+            status, _, body = _http(router.port, "GET", "/metrics")
+            text = body.decode()
+            assert status == 200
+            assert "relora_router_proxied_total" in text
+            assert "relora_router_healthy_replicas 2" in text
+    finally:
+        a.close()
+        b.close()
+
+
+def test_router_retries_pre_stream_failure_on_sibling():
+    """A replica that accepts and drops before any response byte is retried
+    transparently on a sibling: the client sees one complete 200 stream."""
+    a, b = _FakeReplica(), _FakeReplica()
+    a.mode = "drop"
+    b.queue_depth = 1  # bias the first pick to a, so the drop path runs
+    harness = _RouterHarness(
+        {"a": ("127.0.0.1", a.port), "b": ("127.0.0.1", b.port)},
+        probe_interval_s=0.05,
+        retry_backoff_s=0.01,
+    )
+    try:
+        with harness as router:
+            harness.wait_healthy(2)
+            status, headers, body = _http(
+                router.port, "POST", "/v1/generate",
+                {"prompt": [1], "max_new_tokens": 3},
+            )
+            assert status == 200
+            assert headers["x-relora-replica"] == "b"
+            events = _sse_events(body)
+            assert events[-1] == "[DONE]" and events[-2]["finish_reason"] == "length"
+            assert a.gen_hits == 1  # the dropped first attempt
+            snap = router.stats.snapshot()
+            assert snap.get("retries_total", 0) >= 1
+            assert snap.get("upstream_failures_total.a", 0) >= 1
+            assert snap.get("failovers_total.b", 0) >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_router_midstream_death_is_typed_error_not_replay():
+    """Once body bytes have been streamed, a dying replica must NOT trigger
+    a retry (generation is not idempotent): the client gets the partial
+    events, a typed ``stream_interrupted`` error event, and no [DONE]."""
+    a, b = _FakeReplica(), _FakeReplica()
+    a.mode = "die_midstream"
+    b.queue_depth = 1  # bias the pick to a
+    harness = _RouterHarness(
+        {"a": ("127.0.0.1", a.port), "b": ("127.0.0.1", b.port)},
+        probe_interval_s=0.05,
+    )
+    try:
+        with harness as router:
+            harness.wait_healthy(2)
+            status, headers, body = _http(
+                router.port, "POST", "/v1/generate",
+                {"prompt": [1], "max_new_tokens": 5},
+            )
+            assert status == 200 and headers["x-relora-replica"] == "a"
+            events = _sse_events(body)
+            assert "[DONE]" not in events, "a broken stream must not claim success"
+            assert [e["token"] for e in events[:-1]] == [1, 2]  # partial output
+            err = events[-1]["error"]
+            assert err["type"] == "stream_interrupted"
+            assert err["replica"] == "a"
+            assert err["retryable"] is False
+            assert b.gen_hits == 0, "mid-stream failure must never replay"
+            snap = router.stats.snapshot()
+            assert snap.get("midstream_errors_total.a", 0) == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_router_circuit_opens_on_5xx_and_closes_via_probe():
+    """Consecutive 5xx opens the replica's circuit (requests stop flowing);
+    when the replica recovers, a successful health probe is the half-open
+    trial that closes it and traffic resumes."""
+    a = _FakeReplica()
+    harness = _RouterHarness(
+        {"a": ("127.0.0.1", a.port)},
+        probe_interval_s=2.0,  # long: the breaker, not the prober, drives this
+        failure_threshold=2,
+        cooldown_s=30.0,  # only a probe success can close it in test time
+        retry_backoff_s=0.01,
+        max_attempts=2,
+    )
+    try:
+        with harness as router:
+            harness.wait_healthy(1)
+            a.mode = "http500"
+            a.alive = False  # next probe round will also eject it
+            # two quick requests inside the stale-health window: each gets
+            # the passthrough 500, each charges the breaker
+            for _ in range(2):
+                status, _, body = _http(
+                    router.port, "POST", "/v1/generate",
+                    {"prompt": [1], "max_new_tokens": 2},
+                )
+                assert status == 500 and b"injected" in body
+            br = router.replicas["a"].breaker
+            assert br.state == "open" and br.opens_total >= 1
+            # circuit open (and soon: probe marks unhealthy): no replica
+            status, _, body = _http(
+                router.port, "POST", "/v1/generate",
+                {"prompt": [1], "max_new_tokens": 2},
+            )
+            assert status == 503
+            assert b"no healthy replica" in body
+
+            # recovery: healthz 200 again -> probe closes the circuit
+            a.mode, a.alive = "ok", True
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st = router.replicas["a"]
+                if st.healthy and st.breaker.state == "closed":
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("circuit never closed after recovery")
+            status, headers, body = _http(
+                router.port, "POST", "/v1/generate",
+                {"prompt": [1], "max_new_tokens": 2},
+            )
+            assert status == 200 and headers["x-relora-replica"] == "a"
+            assert _sse_events(body)[-1] == "[DONE]"
+    finally:
+        a.close()
+
+
+def test_router_503s_with_retry_after_when_fleet_is_down():
+    """No routable replica and nothing streamed: a typed 503 with a
+    Retry-After hint, not a hang."""
+    with _RouterHarness({}, probe_interval_s=0.05) as router:
+        status, headers, body = _http(
+            router.port, "POST", "/v1/generate", {"prompt": [1], "max_new_tokens": 2}
+        )
+        assert status == 503
+        assert headers.get("retry-after") == "1"
+        assert json.loads(body)["error"] == "no healthy replica available"
+        status, _, body = _http(router.port, "GET", "/healthz")
+        assert status == 503 and json.loads(body)["status"] == "unavailable"
+
+
+# -- the acceptance drill: a real fleet, a real crash -------------------------
+
+
+class _Fleet:
+    """2 serve.py --random-init replicas under a real ReplicaSupervisor,
+    fronted by a real Router.  Replica 0 is armed (via env, first
+    incarnation only) to ``os._exit(13)`` mid-decode once its cumulative
+    token count passes ``crash_at``."""
+
+    def __init__(self, workdir: str, crash_at: int = 40):
+        self.events = []
+        self._ev_lock = threading.Lock()
+        self.sup = ReplicaSupervisor(
+            [
+                sys.executable,
+                os.path.join(ROOT, "serve.py"),
+                "--model_config", "llama_9m",
+                "--random-init",
+                "--max-batch", "4",
+                "--max-queue", "16",
+                "--no-warmup",
+            ],
+            2,
+            workdir,
+            backoff_base_s=0.1,
+            backoff_cap_s=1.0,
+            backoff_jitter=0.0,
+            quarantine_after=5,
+            poll_interval_s=0.05,
+            env_overrides={
+                0: {"RELORA_TPU_FAULTS": f"serve_crash:at_token={crash_at},code=13"}
+            },
+            env_overrides_respawn=False,  # restart comes back clean
+            on_event=self._on_event,
+        )
+        self.harness = _RouterHarness(
+            self.sup.endpoints,
+            probe_interval_s=0.1,
+            retry_backoff_s=0.02,
+            failure_threshold=2,
+            cooldown_s=0.2,
+        )
+        self.router = None
+
+    def _on_event(self, event, idx, detail):
+        with self._ev_lock:
+            self.events.append((event, idx, dict(detail)))
+
+    def event_count(self, name, idx=None):
+        with self._ev_lock:
+            return sum(
+                1 for e, i, _ in self.events if e == name and (idx is None or i == idx)
+            )
+
+    def start(self):
+        self.sup.start()
+        self.router = self.harness.__enter__()
+        self.harness.wait_healthy(2, timeout=120.0)
+        return self
+
+    def stop(self):
+        try:
+            self.harness.__exit__(None, None, None)
+        finally:
+            self.sup.stop()
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    fl = _Fleet(str(tmp_path_factory.mktemp("fleet")))
+    fl.start()
+    yield fl
+    fl.stop()
+
+
+def _drive_stream(port, payload, out, idx):
+    """One client: record how its request terminated (never raises)."""
+    try:
+        status, headers, body = _http(port, "POST", "/v1/generate", payload, timeout=60.0)
+        if status != 200:
+            out[idx] = ("http_error", status)
+            return
+        events = _sse_events(body)
+        if events and events[-1] == "[DONE]":
+            out[idx] = ("finished", events[-2].get("finish_reason"))
+        elif events and isinstance(events[-1], dict) and "error" in events[-1]:
+            out[idx] = ("typed_error", events[-1]["error"]["type"])
+        else:
+            out[idx] = ("truncated", len(events))
+    except Exception as e:  # a hung/errored client is a failed drill
+        out[idx] = ("exception", repr(e))
+
+
+def test_replica_crash_under_load_no_request_hangs(fleet):
+    """Acceptance: SIGKILL-shaped crash (os._exit mid-decode) on replica 0
+    under 8 concurrent streams.  Every accepted request terminates — as a
+    finish record, a typed error, or an HTTP error — none hang; the
+    supervisor restarts the dead replica; traffic reaches it again once its
+    circuit closes."""
+    port = fleet.router.port
+    results = [None] * 8
+    threads = [
+        threading.Thread(
+            target=_drive_stream,
+            args=(
+                port,
+                {"prompt": [i + 1, 2, 3], "max_new_tokens": 20},
+                results,
+                i,
+            ),
+        )
+        for i in range(len(results))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    assert not any(t.is_alive() for t in threads), f"hung clients: {results}"
+    assert all(r is not None for r in results), results
+
+    # the crash actually happened (8 x 20 tokens across 2 replicas crosses
+    # replica 0's at_token=40 trigger)...
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if fleet.event_count("crash", idx=0) >= 1:
+            break
+        time.sleep(0.1)
+    assert fleet.event_count("crash", idx=0) >= 1, fleet.events
+    # ...and every request still terminated in a defined way
+    kinds = [kind for kind, _ in results]
+    assert "truncated" not in kinds and "exception" not in kinds, results
+    finished = kinds.count("finished")
+    assert finished >= 1, results
+
+    # the supervisor restarts replica 0 (clean incarnation: the fault env
+    # applies to the first spawn only)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if fleet.event_count("respawn", idx=0) >= 1:
+            break
+        time.sleep(0.1)
+    assert fleet.event_count("respawn", idx=0) >= 1, fleet.events
+    assert fleet.sup.status()["r0"]["restarts"] >= 1
+    fleet.harness.wait_healthy(2, timeout=120.0)
+
+    # traffic returns to the restarted replica once its circuit closes
+    seen = set()
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and "r0" not in seen:
+        status, headers, _ = _http(
+            port, "POST", "/v1/generate", {"prompt": [5, 6], "max_new_tokens": 2},
+            timeout=60.0,
+        )
+        if status == 200:
+            seen.add(headers.get("x-relora-replica"))
+    assert "r0" in seen, f"restarted replica never served again: {seen}"
+
+    status, _, body = _http(port, "GET", "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "relora_router_proxied_total" in text
+    assert "relora_router_healthy_replicas 2" in text
+
+
+def test_rolling_drain_loses_zero_requests(fleet):
+    """SIGTERM semantics: with streams in flight, a rolling drain finishes
+    every one of them (replicas drain one at a time while the rest of the
+    fleet keeps serving)."""
+    port = fleet.router.port
+    results = [None] * 4
+    threads = [
+        threading.Thread(
+            target=_drive_stream,
+            args=(port, {"prompt": [i + 1, 9], "max_new_tokens": 30}, results, i),
+        )
+        for i in range(len(results))
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # let the streams start before the drain begins
+    drainer = threading.Thread(target=fleet.sup.begin_rolling_drain)
+    drainer.start()
+    for t in threads:
+        t.join(120.0)
+    drainer.join(120.0)
+    assert not drainer.is_alive(), "rolling drain never completed"
+    assert not any(t.is_alive() for t in threads), f"hung clients: {results}"
+    # zero loss: every in-flight stream ran to a normal finish
+    assert all(r == ("finished", "length") for r in results), results
+    assert fleet.event_count("drain_complete") == 2
+    st = fleet.sup.status()
+    assert not st["r0"]["running"] and not st["r1"]["running"]

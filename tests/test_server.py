@@ -503,3 +503,176 @@ def test_request_id_header_and_span_propagation(engine):
         rid2 = headers2["x-request-id"]
         assert rid2 != rid and len(rid2) == 16
         int(rid2, 16)  # hex
+
+
+# -- dynamic Retry-After ------------------------------------------------------
+
+
+def test_dynamic_retry_after_tracks_queue_and_tpot():
+    """The Retry-After hint is the time for the current queue to clear at
+    the observed decode rate (depth x rolling TPOT), clamped to
+    [max(1, floor), 30]; a cold server falls back to the configured floor."""
+    from relora_tpu.serve.admission import AdmissionController, Ticket
+
+    def _ticket(uid):
+        return Ticket(
+            uid=uid,
+            request=Request(uid=uid, prompt=[1], max_new_tokens=1),
+            deadline=None,
+            on_token=lambda *_: None,
+            on_finish=lambda *_: None,
+        )
+
+    adm = AdmissionController(8, retry_after_s=2.0)
+    assert adm.retry_after_s == 2.0  # cold: the old fixed behaviour
+    adm.note_tpot(0.5)
+    assert adm.retry_after_s == 2.0  # empty queue: floor still rules
+    for uid in range(6):
+        adm.try_admit(_ticket(uid))
+    assert adm.retry_after_s == pytest.approx(6 * 0.5)  # depth x TPOT
+    adm.note_tpot(10.0)  # EWMA folds 0.8/0.2 -> 2.4 s/token
+    assert adm.retry_after_s == pytest.approx(6 * 2.4)
+    adm.note_tpot(100.0)  # estimate explodes past the cap
+    assert adm.retry_after_s == AdmissionController.RETRY_AFTER_CAP_S
+    adm.note_tpot(-1.0)  # nonsense observations are ignored
+    assert adm.retry_after_s == AdmissionController.RETRY_AFTER_CAP_S
+
+    # sub-second floors round up to 1s: "Retry-After: 0" helps nobody
+    assert AdmissionController(8, retry_after_s=0.2).retry_after_s == 1.0
+
+
+# -- self-diagnosis drills (fault-injected) -----------------------------------
+
+from relora_tpu.utils import faults  # noqa: E402
+
+
+@pytest.fixture
+def disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.mark.faults
+def test_model_thread_death_fails_all_requests(engine, disarm_faults):
+    """An exception on the model thread (injected ``serve_decode``) must
+    terminally complete every in-flight and queued request with
+    ``finish_reason="error"`` — not strand their streams — and flip
+    /healthz to 503 "error" while the listener lingers."""
+    faults.configure("serve_decode", exc=RuntimeError, at_token=4)
+    scheduler = ContinuousBatchingScheduler(
+        engine, max_batch=2, key=jax.random.PRNGKey(11)
+    )
+    server = GenerateServer(scheduler, port=0, max_queue=4, error_linger_s=8.0)
+
+    def run():
+        try:
+            asyncio.run(server.serve_forever(install_signal_handlers=False))
+        except RuntimeError:
+            pass  # serve_forever re-raises the worker death; expected here
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert server.started.wait(60), "server failed to start"
+    port = server.port
+    a = _Stream(port, {"prompt": [1, 2], "max_new_tokens": 50})
+    b = _Stream(port, {"prompt": [3, 4], "max_new_tokens": 50})
+    assert a.status == 200 and b.status == 200
+    for stream in (a, b):
+        events = stream.read_to_done()  # [DONE] still arrives: typed failure
+        final = events[-1]
+        assert final["finish_reason"] == "error"
+        assert "model thread died" in final["error"]
+        assert "injected fault at 'serve_decode'" in final["error"]
+    a.close()
+    b.close()
+
+    # the listener lingers so probes see *why* it is about to exit
+    status, _, body = _http(port, "GET", "/healthz")
+    health = json.loads(body)
+    assert status == 503 and health["status"] == "error"
+    assert "injected fault" in health["detail"]
+    # new work fails fast instead of queueing behind a dead worker
+    status, _, body = _http(
+        port, "POST", "/v1/generate", {"prompt": [5], "max_new_tokens": 2}
+    )
+    assert status == 500 and b"model thread died" in body
+    status, _, body = _http(port, "GET", "/metrics")
+    text = body.decode()
+    assert "relora_serve_model_dead 1" in text
+    assert 'relora_serve_requests_finished_total{reason="error"} 2' in text
+
+    thread.join(60)
+    assert not thread.is_alive(), "server did not shut down after worker death"
+    assert isinstance(server._worker_error, RuntimeError)
+
+
+@pytest.mark.faults
+def test_stall_watchdog_flips_healthz_and_recovers(
+    engine, disarm_faults, tmp_path, monkeypatch
+):
+    """No decode progress for stall_timeout_s (injected ``serve_stall``)
+    flips /healthz to 503 "stuck" and dumps the flight recorder; when the
+    decode loop resumes, the replica un-sticks by itself."""
+    monkeypatch.setenv("RELORA_TPU_FLIGHT_DIR", str(tmp_path))
+    faults.configure("serve_stall", sleep_s=1.5, at_token=2)
+    with _Server(engine, max_batch=1, stall_timeout_s=0.3) as server:
+        port = server.port
+        a = _Stream(port, {"prompt": [1, 2], "max_new_tokens": 30})
+        assert a.status == 200
+
+        saw = None
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            status, _, body = _http(port, "GET", "/healthz")
+            saw = (status, json.loads(body))
+            if status == 503 and saw[1]["status"] == "stuck":
+                break
+            time.sleep(0.03)
+        assert saw is not None and saw[1]["status"] == "stuck", saw
+        assert "no decode step" in saw[1]["detail"]
+
+        # the stall ends; the stream still finishes in full
+        events = a.read_to_done()
+        assert events[-1]["finish_reason"] == "length"
+        assert len(events[-1]["tokens"]) == 30
+        a.close()
+
+        recovered = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            status, _, body = _http(port, "GET", "/healthz")
+            if status == 200 and json.loads(body)["status"] == "ok":
+                recovered = True
+                break
+            time.sleep(0.03)
+        assert recovered, "healthz never recovered after the stall"
+        _, _, body = _http(port, "GET", "/metrics")
+        assert "relora_serve_stuck 0" in body.decode()
+
+    dumps = list(tmp_path.glob("flight_serve_stall_*.json"))
+    assert dumps, "watchdog did not dump the flight recorder"
+    dump = json.loads(dumps[0].read_text())
+    assert dump["reason"] == "serve_stall"
+
+
+@pytest.mark.faults
+def test_accept_drop_closes_connection_then_recovers(engine, disarm_faults):
+    """``serve_accept_drop``: the first accepted connection dies with zero
+    response bytes (what a router's pre-stream retry must absorb); the next
+    one is served normally."""
+    faults.configure("serve_accept_drop", times=1)
+    with _Server(engine, max_batch=1) as server:
+        port = server.port
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as sock:
+            sock.sendall(_request_bytes("GET", "/healthz", b""))
+            # closed with zero response bytes: clean EOF or RST (the server
+            # hung up with our request unread), never a served response
+            try:
+                assert sock.recv(4096) == b"", "dropped connection sent data"
+            except ConnectionResetError:
+                pass
+        status, _, _ = _http(port, "GET", "/healthz")
+        assert status == 200
+        status, _, body = _http(port, "GET", "/metrics")
+        assert "relora_serve_accept_drops_total 1" in body.decode()

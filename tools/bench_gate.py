@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Three rules, each skipped gracefully when its input files are absent:
+Four rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -12,7 +12,10 @@ Three rules, each skipped gracefully when its input files are absent:
    per-level ``ttft_p95_ms`` / ``tpot_p95_ms`` must stay under the committed
    caps (baseline p95 x (1 + tolerance), pre-expanded in the baselines file
    with generous CPU-noise margins).
-3. **obs overhead** (``BENCH_obs.json``): ``detail.within_budget`` must be
+3. **router failover** (``BENCH_http.json`` ``detail.router``): zero hung
+   requests under a mid-run replica SIGKILL, the killed replica restarted,
+   and clean/kill ``ttft_p95_ms`` under the committed router caps.
+4. **obs overhead** (``BENCH_obs.json``): ``detail.within_budget`` must be
    true — the span tracer's measured overhead stayed inside its budget_pct.
 
 Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
@@ -96,6 +99,42 @@ def check_http(bench_dir: str, baselines: Optional[Dict[str, Any]]) -> List[str]
     return failures
 
 
+def check_router(bench_dir: str, baselines: Optional[Dict[str, Any]]) -> List[str]:
+    """Multi-replica failover rules over ``detail.router`` in BENCH_http.json
+    (present only for ``bench.py --mode serve_load --router`` runs):
+
+    - hung_requests must be 0 — a crash degrades to retried or typed-error,
+      never to a client waiting forever;
+    - the SIGKILLed replica must have been restarted inside the bench window;
+    - per-run ttft_p95_ms must stay under the committed router caps.
+    """
+    doc = _load(os.path.join(bench_dir, "BENCH_http.json"))
+    router = ((doc or {}).get("detail") or {}).get("router")
+    if not router:
+        return []
+    failures = []
+    hung = router.get("hung_requests", 0)
+    if hung:
+        failures.append(
+            f"router: {hung} hung request(s) under replica failure — every "
+            "accepted request must terminate (finish record or typed error)"
+        )
+    if router.get("replica0_restarted") is False:
+        failures.append("router: SIGKILLed replica was not restarted during the bench")
+    caps = (baselines or {}).get("router_p95_caps_ms") or {}
+    for run in ("clean", "kill"):
+        cap = caps.get(run)
+        row = router.get(run) or {}
+        if not cap:
+            continue
+        got, limit = row.get("ttft_p95_ms"), cap.get("ttft_p95_ms")
+        if isinstance(got, (int, float)) and isinstance(limit, (int, float)) and got > limit:
+            failures.append(
+                f"router {run}: ttft_p95_ms = {got:.1f}ms exceeds cap {limit:.1f}ms"
+            )
+    return failures
+
+
 def check_obs(bench_dir: str) -> List[str]:
     doc = _load(os.path.join(bench_dir, "BENCH_obs.json"))
     if not doc:
@@ -142,6 +181,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     failures = (
         check_train(args.dir, args.tolerance)
         + check_http(args.dir, baselines)
+        + check_router(args.dir, baselines)
         + check_obs(args.dir)
     )
 
