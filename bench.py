@@ -405,20 +405,33 @@ def serve_load_main(router: bool = False) -> None:
     cache_size = 1 << (max_prompt + new_tokens + 8 - 1).bit_length()
     model = build_decode_model(cfg, cache_size=cache_size)
     params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
-    if paged:
-        num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
-        engine = InferenceEngine(
-            cfg, params, cache_size=cache_size,
-            page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
-        )
-        engine.warmup(max_batch)
-        scheduler = PagedContinuousBatchingScheduler(engine, max_batch=max_batch)
-    else:
-        engine = InferenceEngine(cfg, params, cache_size=cache_size)
-        buckets = sorted({prompt_len} | ({long_prompt_len} if long_share > 0 else set()))
-        engine.warmup(max_batch, prompt_buckets=tuple(buckets))
-        scheduler = ContinuousBatchingScheduler(engine, max_batch=max_batch)
-    server = GenerateServer(scheduler, port=0, max_queue=max_queue)
+    # paged runs sweep the kv_dtype dial so the artifact shows the int8
+    # slot-count / TTFT / TPOT effect next to bf16 (first dtype is the
+    # headline run the gate reads)
+    kv_dtypes = (
+        [d.strip() for d in os.environ.get("BENCH_HTTP_KV_DTYPES", "bf16,int8").split(",") if d.strip()]
+        if paged
+        else ["bf16"]
+    )
+
+    def build_stack(kv_dtype: str):
+        if paged:
+            num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
+            eng = InferenceEngine(
+                cfg, params, cache_size=cache_size,
+                page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
+                kv_dtype=kv_dtype,
+            )
+            eng.warmup(max_batch)
+            sched = PagedContinuousBatchingScheduler(eng, max_batch=max_batch)
+        else:
+            eng = InferenceEngine(cfg, params, cache_size=cache_size)
+            buckets = sorted({prompt_len} | ({long_prompt_len} if long_share > 0 else set()))
+            eng.warmup(max_batch, prompt_buckets=tuple(buckets))
+            sched = ContinuousBatchingScheduler(eng, max_batch=max_batch)
+        return eng, sched, GenerateServer(sched, port=0, max_queue=max_queue)
+
+    engine, scheduler, server = build_stack(kv_dtypes[0])
 
     rng = np.random.RandomState(0)
     prompts = [
@@ -728,6 +741,26 @@ def serve_load_main(router: bool = False) -> None:
         }
 
     rows = asyncio.run(bench())
+    dtype_runs = {}
+    if paged:
+        def dtype_entry(eng, run_rows) -> dict:
+            pk = max(run_rows, key=lambda r: r["throughput_tokens_per_s"])
+            return {
+                "kv_cache_bytes": eng.pool_bytes(),
+                "kv_bytes_per_token": round(eng.kv_bytes_per_token(), 4),
+                "page_bytes": eng.pool_bytes() // eng.num_pages,
+                # the slot-count effect: pages one GiB of pool HBM would hold
+                "pages_per_gib": int((1 << 30) // max(eng.pool_bytes() // eng.num_pages, 1)),
+                "peak_throughput_tokens_per_s": pk["throughput_tokens_per_s"],
+                "ttft_p50_ms_at_peak": pk["ttft_p50_ms"],
+                "tpot_p50_ms_at_peak": pk["tpot_p50_ms"],
+                "levels": run_rows,
+            }
+
+        dtype_runs[kv_dtypes[0]] = dtype_entry(engine, rows)
+        for kv_dtype in kv_dtypes[1:]:
+            engine, scheduler, server = build_stack(kv_dtype)
+            dtype_runs[kv_dtype] = dtype_entry(engine, asyncio.run(bench()))
     router_detail = router_phase() if router else None
     peak = max(rows, key=lambda r: r["throughput_tokens_per_s"])
     saturated = max(rows, key=lambda r: r["reject_rate"])
@@ -754,6 +787,8 @@ def serve_load_main(router: bool = False) -> None:
                     "page_size": page_size,
                     "num_pages": engine.num_pages,
                     "chunk_size": engine.chunk_size,
+                    "kv_dtype": kv_dtypes[0],
+                    "kv_dtype_runs": dtype_runs,
                 }
                 if paged
                 else {}
@@ -849,6 +884,166 @@ def lora_kernel_main() -> None:
         },
     }
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_lora.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+def attention_main() -> None:
+    """--mode attention: per-shape step time of the serving attention arms
+    against a real page pool — naive (gather + masked einsum) vs the fused
+    paged-decode kernel, each over bf16-stored and int8-quantized pools —
+    plus causal prefill arms (naive / xla / pallas flash) and what the
+    ops/attention_dispatch cost model would pick.  Mirrors BENCH_lora.json:
+    off-TPU the pallas arms run the interpreter (``is_interpret`` flagged in
+    the artifact — a correctness record, not a performance claim)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from relora_tpu.ops.attention import (
+        dot_product_attention,
+        flash_block_size,
+        paged_cached_attention,
+        paged_decode_attention,
+    )
+    from relora_tpu.ops.attention_dispatch import choose_arm
+    from relora_tpu.ops.quant import quantize_kv_page
+
+    on_tpu = jax.default_backend() == "tpu"
+    # decode shapes are (B, S_kv); CPU-interpret fused arms are slow, so
+    # default small off-TPU
+    decode_default = "4:1024,8:2048" if on_tpu else "2:128,4:256"
+    prefill_default = "1:1024,1:2048" if on_tpu else "1:128,1:256"
+    decode_shapes = [
+        tuple(int(v) for v in s.split(":"))
+        for s in os.environ.get("BENCH_ATTN_DECODE_SHAPES", decode_default).split(",")
+    ]
+    prefill_shapes = [
+        tuple(int(v) for v in s.split(":"))
+        for s in os.environ.get("BENCH_ATTN_PREFILL_SHAPES", prefill_default).split(",")
+    ]
+    heads = int(os.environ.get("BENCH_ATTN_HEADS", "8"))
+    kv_heads = int(os.environ.get("BENCH_ATTN_KV_HEADS", "4"))
+    head_dim = int(os.environ.get("BENCH_ATTN_HEAD_DIM", "64"))
+    page_size = int(os.environ.get("BENCH_ATTN_PAGE_SIZE", "16"))
+    iters = int(os.environ.get("BENCH_ATTN_ITERS", "20" if on_tpu else "3"))
+    dtype_name = os.environ.get("BENCH_ATTN_DTYPE", "bf16" if on_tpu else "f32")
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+
+    def time_arm(fn, *operands) -> float:
+        jax.block_until_ready(fn(*operands))  # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*operands)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    key = jax.random.PRNGKey(0)
+    buckets = []
+    for B, S_kv in decode_shapes:
+        if S_kv % page_size:
+            continue
+        W = S_kv // page_size
+        num_pages = B * W + 1
+        ks = jax.random.split(jax.random.fold_in(key, B * 131 + S_kv), 3)
+        q = jax.random.normal(ks[0], (B, 1, heads, head_dim), dtype)
+        pool_k = jax.random.normal(ks[1], (num_pages, page_size, kv_heads, head_dim), dtype)
+        pool_v = jax.random.normal(ks[2], (num_pages, page_size, kv_heads, head_dim), dtype)
+        # each row owns its own W pages (1-based: page 0 is the null page)
+        bt = 1 + jnp.arange(B * W, dtype=jnp.int32).reshape(B, W)
+        pos = jnp.full((B, 1), S_kv - 1, jnp.int32)
+        qk, k_scale = quantize_kv_page(pool_k)
+        qv, v_scale = quantize_kv_page(pool_v)
+
+        row = {
+            "kind": "decode", "B": B, "S_kv": S_kv, "heads": heads,
+            "kv_heads": kv_heads, "head_dim": head_dim, "page_size": page_size,
+        }
+        naive16 = jax.jit(lambda q, k, v, bt, pos: paged_cached_attention(q, k, v, bt, pos))
+        row["naive_bf16_ms"] = round(time_arm(naive16, q, pool_k, pool_v, bt, pos) * 1e3, 4)
+        fused16 = jax.jit(
+            lambda q, k, v, bt, pos: paged_decode_attention(
+                q, k, v, bt, pos, interpret=not on_tpu
+            )
+        )
+        row["paged_decode_bf16_ms"] = round(time_arm(fused16, q, pool_k, pool_v, bt, pos) * 1e3, 4)
+        naive8 = jax.jit(
+            lambda q, k, v, bt, pos, ks, vs: paged_cached_attention(
+                q, k, v, bt, pos, k_scale=ks, v_scale=vs
+            )
+        )
+        row["naive_int8_ms"] = round(
+            time_arm(naive8, q, qk, qv, bt, pos, k_scale, v_scale) * 1e3, 4
+        )
+        fused8 = jax.jit(
+            lambda q, k, v, bt, pos, ks, vs: paged_decode_attention(
+                q, k, v, bt, pos, k_scale=ks, v_scale=vs, interpret=not on_tpu
+            )
+        )
+        row["paged_decode_int8_ms"] = round(
+            time_arm(fused8, q, qk, qv, bt, pos, k_scale, v_scale) * 1e3, 4
+        )
+        for kv_bytes, tag in ((jnp.dtype(dtype).itemsize, "bf16"), (1, "int8")):
+            row[f"model_choice_{tag}"] = choose_arm(
+                B, 1, S_kv, heads, kv_heads, head_dim, page_size, kv_bytes,
+                fused_available=on_tpu, allow=("naive", "paged_decode"),
+            )
+        row["measured_best"] = min(
+            ("naive_bf16", "paged_decode_bf16", "naive_int8", "paged_decode_int8"),
+            key=lambda a: row[f"{a}_ms"],
+        )
+        buckets.append(row)
+
+    for B, S in prefill_shapes:
+        ks = jax.random.split(jax.random.fold_in(key, B * 977 + S), 3)
+        q = jax.random.normal(ks[0], (B, S, heads, head_dim), dtype)
+        k = jax.random.normal(ks[1], (B, S, kv_heads, head_dim), dtype)
+        v = jax.random.normal(ks[2], (B, S, kv_heads, head_dim), dtype)
+        row = {
+            "kind": "prefill", "B": B, "S": S, "heads": heads,
+            "kv_heads": kv_heads, "head_dim": head_dim,
+            "flash_block": flash_block_size(S, S),
+        }
+        for impl in ("naive", "xla") + (("pallas",) if on_tpu else ()):
+            fn = jax.jit(
+                lambda q, k, v, _impl=impl: dot_product_attention(
+                    q, k, v, causal=True, impl=_impl
+                )
+            )
+            row[f"{impl}_ms"] = round(time_arm(fn, q, k, v) * 1e3, 4)
+        row["model_choice"] = choose_arm(
+            B, S, S, heads, kv_heads, head_dim, page_size,
+            jnp.dtype(dtype).itemsize, fused_available=on_tpu,
+        )
+        buckets.append(row)
+
+    decode_rows = [r for r in buckets if r["kind"] == "decode"]
+    top = decode_rows[-1] if decode_rows else None
+    result = {
+        "bench": "attention",
+        "metric": (
+            f"paged-decode fused kernel speedup vs naive gather "
+            f"(int8 pool, B={top['B']} S_kv={top['S_kv']}, {dtype_name})"
+            if top
+            else "paged-decode attention (no decode buckets)"
+        ),
+        "value": (
+            round(top["naive_int8_ms"] / top["paged_decode_int8_ms"], 4) if top else 0.0
+        ),
+        "unit": "x",
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "backend": jax.default_backend(),
+            "is_interpret": not on_tpu,
+            "dtype": dtype_name,
+            "iters": iters,
+            "page_size": page_size,
+            "buckets": buckets,
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_attn.json")
     with open(out_path, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result))
@@ -959,7 +1154,7 @@ if __name__ == "__main__":
     _ap = argparse.ArgumentParser()
     _ap.add_argument(
         "--mode",
-        choices=["train", "decode", "lint", "lora_kernel", "serve_load", "obs_overhead"],
+        choices=["train", "decode", "lint", "lora_kernel", "attention", "serve_load", "obs_overhead"],
         default="train",
     )
     _ap.add_argument(
@@ -983,6 +1178,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if _cli.mode == "lora_kernel":
         lora_kernel_main()
+        sys.exit(0)
+    if _cli.mode == "attention":
+        attention_main()
         sys.exit(0)
     if os.environ.get("BENCH_FORCE") != "1":
         platform, err = _probe_device()

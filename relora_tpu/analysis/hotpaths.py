@@ -47,7 +47,10 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "cached_attention",
         "gather_kv_pages",
         "paged_cached_attention",
+        "dequantize_gathered_pages",
+        "paged_decode_attention",
     ],
+    "relora_tpu/ops/attention_dispatch.py": [""],
     "relora_tpu/serve/engine.py": [
         "InferenceEngine.prefill",
         "InferenceEngine.decode",

@@ -30,11 +30,8 @@ from flax import linen as nn
 from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
 from relora_tpu.models.lora import LoRALinear
-from relora_tpu.ops.attention import (
-    cached_attention,
-    dot_product_attention,
-    paged_cached_attention,
-)
+from relora_tpu.ops.attention import cached_attention, dot_product_attention
+from relora_tpu.ops.attention_dispatch import paged_attention
 
 
 def attend_with_cache(
@@ -92,6 +89,17 @@ def attend_with_paged_cache(
     so garbage writes from idle decode rows and chunk padding land where
     nothing ever reads unmasked.  Under ``nn.scan`` the pool stacks on the
     leading "layers" axis, exactly like the contiguous cache.
+
+    ``module.kv_dtype == "int8"`` stores the pool as int8 codes plus f32
+    per-``(page, kv_head)`` absmax scales (ops/quant.quantize_kv_page
+    layout).  Pages fill incrementally — one chunk or decode token at a
+    time — so each write maintains the scales as a *running max*: grow the
+    touched pages' scales to cover the incoming tokens, requantize the
+    already-written codes of exactly those pages by ``old/new``, then write
+    the fresh tokens at the new scale.  Untouched pages never move, and
+    duplicate page indices in one write scatter identical values, so the
+    update is well-defined.  Garbage writes can inflate the null page's
+    scale — it is only ever read masked, like its codes.
     """
     B, T = q.shape[:2]
     ps, num_pages = module.page_size, module.num_pages
@@ -100,16 +108,53 @@ def attend_with_paged_cache(
     if block_tables is None:
         raise ValueError("paged decode requires block_tables (got None)")
     n_kv, hd = k_new.shape[2], k_new.shape[3]
-    ck = module.variable("cache", "k", jnp.zeros, (num_pages, ps, n_kv, hd), k_new.dtype)
-    cv = module.variable("cache", "v", jnp.zeros, (num_pages, ps, n_kv, hd), v_new.dtype)
+    quantized = getattr(module, "kv_dtype", "bf16") == "int8"
+    pool_dtype = jnp.int8 if quantized else k_new.dtype
+    ck = module.variable("cache", "k", jnp.zeros, (num_pages, ps, n_kv, hd), pool_dtype)
+    cv = module.variable("cache", "v", jnp.zeros, (num_pages, ps, n_kv, hd), pool_dtype)
     positions = jnp.broadcast_to(positions, (B, T)).astype(jnp.int32)
     W = block_tables.shape[1]
     logical = jnp.clip(positions // ps, 0, W - 1)
     rows = jnp.take_along_axis(block_tables, logical, axis=1)  # (B, T) pool pages
     offs = positions % ps
-    ck.value = ck.value.at[rows, offs].set(k_new.astype(ck.value.dtype))
-    cv.value = cv.value.at[rows, offs].set(v_new.astype(cv.value.dtype))
-    return paged_cached_attention(q, ck.value, cv.value, block_tables, positions)
+
+    if not quantized:
+        ck.value = ck.value.at[rows, offs].set(k_new.astype(ck.value.dtype))
+        cv.value = cv.value.at[rows, offs].set(v_new.astype(cv.value.dtype))
+        return paged_attention(q, ck.value, cv.value, block_tables, positions)
+
+    cks = module.variable("cache", "k_scale", jnp.zeros, (num_pages, n_kv), jnp.float32)
+    cvs = module.variable("cache", "v_scale", jnp.zeros, (num_pages, n_kv), jnp.float32)
+    flat_rows = rows.reshape(-1)  # (B*T,)
+
+    def write_quantized(codes, scales, new):
+        new32 = new.astype(jnp.float32)
+        # candidate per-token scale: absmax over head_dim -> (B, T, n_kv)
+        cand = jnp.maximum(jnp.max(jnp.abs(new32), axis=-1) / 127.0, 1e-12)
+        new_scale = scales.at[rows].max(cand)  # running max per (page, head)
+        # requantize only the touched pages by old/new (1.0 when unchanged);
+        # first-touch pages have old == 0 -> ratio 0, but their codes are 0
+        ratio = jnp.take(scales, flat_rows, axis=0) / jnp.take(
+            new_scale, flat_rows, axis=0
+        )  # (B*T, n_kv)
+        old_pages = jnp.take(codes, flat_rows, axis=0).astype(jnp.float32)
+        requant = jnp.clip(
+            jnp.round(old_pages * ratio[:, None, :, None]), -127, 127
+        ).astype(jnp.int8)
+        codes = codes.at[flat_rows].set(requant)
+        # fresh tokens at the new scale of their page
+        tok_scale = jnp.take(new_scale, flat_rows, axis=0).reshape(B, T, n_kv)
+        q_new = jnp.clip(
+            jnp.round(new32 / tok_scale[..., None]), -127, 127
+        ).astype(jnp.int8)
+        return codes.at[rows, offs].set(q_new), new_scale
+
+    ck.value, cks.value = write_quantized(ck.value, cks.value, k_new)
+    cv.value, cvs.value = write_quantized(cv.value, cvs.value, v_new)
+    return paged_attention(
+        q, ck.value, cv.value, block_tables, positions,
+        k_scale=cks.value, v_scale=cvs.value,
+    )
 
 
 class RMSNorm(nn.Module):
@@ -196,6 +241,9 @@ class LlamaAttention(nn.Module):
     # forward's ``block_tables`` argument — see attend_with_paged_cache)
     page_size: int = 0
     num_pages: int = 0
+    # "bf16" stores pool pages at the compute dtype (unquantized); "int8"
+    # stores codes + per-(page, kv_head) scales — see attend_with_paged_cache
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(
@@ -270,6 +318,7 @@ class LlamaDecoderLayer(nn.Module):
     cache_size: int = 0
     page_size: int = 0
     num_pages: int = 0
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
@@ -278,6 +327,7 @@ class LlamaDecoderLayer(nn.Module):
         a = LlamaAttention(
             cfg, self.lora, self.dtype, self.attention_impl,
             self.decode, self.cache_size, self.page_size, self.num_pages,
+            self.kv_dtype,
             name="self_attn"
         )(a, cos, sin, positions, deterministic, block_tables)
         x = x + a
@@ -334,6 +384,7 @@ def decoder_stack(
         cache_size=getattr(module, "cache_size", 0),
         page_size=getattr(module, "page_size", 0),
         num_pages=getattr(module, "num_pages", 0),
+        kv_dtype=getattr(module, "kv_dtype", "bf16"),
     )
     if module.scan_layers:
         variable_axes = {"params": 0}
@@ -396,11 +447,13 @@ class LlamaForCausalLM(nn.Module):
     # inference: decode=True turns on the per-layer KV caches ("cache"
     # variable collection) of capacity cache_size (see serve/engine.py);
     # page_size > 0 additionally switches them to the shared paged pool,
-    # reached through the ``block_tables`` call argument
+    # reached through the ``block_tables`` call argument; kv_dtype="int8"
+    # stores the pool quantized (codes + scales, attend_with_paged_cache)
     decode: bool = False
     cache_size: int = 0
     page_size: int = 0
     num_pages: int = 0
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(

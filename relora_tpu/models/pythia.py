@@ -74,6 +74,7 @@ class NeoXAttention(nn.Module):
     # (see models/llama.attend_with_paged_cache)
     page_size: int = 0
     num_pages: int = 0
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
@@ -148,6 +149,7 @@ class NeoXLayer(nn.Module):
     cache_size: int = 0
     page_size: int = 0
     num_pages: int = 0
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
@@ -156,6 +158,7 @@ class NeoXLayer(nn.Module):
         attn_out = NeoXAttention(
             cfg, self.lora, self.dtype, self.attention_impl,
             self.decode, self.cache_size, self.page_size, self.num_pages,
+            self.kv_dtype,
             name="attention"
         )(attn_in, cos, sin, positions, deterministic, block_tables)
         mlp_in = LayerNorm(
@@ -182,11 +185,13 @@ class GPTNeoXForCausalLM(nn.Module):
     # inference: decode=True turns on the per-layer KV caches ("cache"
     # variable collection) of capacity cache_size (see serve/engine.py);
     # page_size > 0 additionally switches them to the shared paged pool,
-    # reached through the ``block_tables`` call argument
+    # reached through the ``block_tables`` call argument; kv_dtype="int8"
+    # stores the pool quantized (see models/llama.attend_with_paged_cache)
     decode: bool = False
     cache_size: int = 0
     page_size: int = 0
     num_pages: int = 0
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(
@@ -237,7 +242,7 @@ class GPTNeoXForCausalLM(nn.Module):
             config=cfg, lora=self.lora, dtype=self.dtype,
             attention_impl=self.attention_impl, decode=self.decode,
             cache_size=self.cache_size, page_size=self.page_size,
-            num_pages=self.num_pages,
+            num_pages=self.num_pages, kv_dtype=self.kv_dtype,
         )
         if self.scan_layers:
             variable_axes = {"params": 0}

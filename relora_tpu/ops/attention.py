@@ -18,10 +18,13 @@ All take/return ``(batch, seq, heads, head_dim)``.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _expand_grouped_kv(q, k, v):
@@ -175,6 +178,26 @@ def cached_attention(
     return out.reshape(B, T, N, H).astype(q.dtype)
 
 
+def dequantize_gathered_pages(
+    kv: jax.Array, scales: jax.Array, block_tables: jax.Array
+) -> jax.Array:
+    """Dequantize a :func:`gather_kv_pages` result of int8 codes back to f32.
+
+    ``kv`` is the gathered ``(B, W * page_size, n_kv, H)`` int8 view,
+    ``scales`` the per-``(page, kv_head)`` f32 scales ``(num_pages, n_kv)``
+    (see ops/quant.quantize_kv_page), gathered here through the same
+    ``block_tables`` so each token row picks up its page's scale.  Null /
+    unwritten pages carry zero codes, so whatever scale they gather
+    dequantizes to exactly 0.0 — masked off downstream either way.
+    """
+    B, S, n_kv, H = kv.shape
+    W = block_tables.shape[1]
+    ps = S // W
+    s = jnp.take(scales, block_tables, axis=0)  # (B, W, n_kv)
+    s = jnp.broadcast_to(s[:, :, None, :], (B, W, ps, n_kv)).reshape(B, S, n_kv)
+    return kv.astype(jnp.float32) * s[..., None]
+
+
 def gather_kv_pages(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
     """Gather a per-row contiguous K/V view out of a shared page pool.
 
@@ -199,6 +222,8 @@ def paged_cached_attention(
     block_tables: jax.Array,
     positions: jax.Array,
     *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
     scale: Optional[float] = None,
 ) -> jax.Array:
     """``cached_attention`` against a paged K/V pool.
@@ -213,10 +238,198 @@ def paged_cached_attention(
     to the pages actually used (a read-bandwidth win for short requests in
     a long-capacity pool) is future work and would trade that bitwise
     guarantee for an allclose one.
+
+    With ``k_scale``/``v_scale`` (per-``(page, kv_head)`` f32, from
+    ops/quant.quantize_kv_page) the pool holds int8 codes; the gathered view
+    is dequantized to f32 before attending.  This is the differential
+    oracle for the fused :func:`paged_decode_attention` kernel — same math,
+    but it materializes both the gathered cache and the score matrix in HBM.
     """
     k = gather_kv_pages(pool_k, block_tables)
     v = gather_kv_pages(pool_v, block_tables)
+    if k_scale is not None:
+        k = dequantize_gathered_pages(k, k_scale, block_tables)
+    if v_scale is not None:
+        v = dequantize_gathered_pages(v, v_scale, block_tables)
     return cached_attention(q, k, v, positions, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Fused paged-decode kernel: pool -> output in one launch, no HBM gather
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    # scalar-prefetch operands (SMEM)
+    bt_ref,  # (B, W) int32 block tables
+    pos_ref,  # (B,) int32 decode positions
+    # VMEM inputs
+    q_ref,  # (1, N, H) this row's query
+    k_ref,  # (1, ps, n_kv, H) pool page selected by bt[b, w]
+    v_ref,  # (1, ps, n_kv, H)
+    ks_ref,  # (1, n_kv) f32 page scales (ones when unquantized)
+    vs_ref,  # (1, n_kv)
+    # VMEM output
+    o_ref,  # (1, N, H)
+    # VMEM scratch, carried across the W grid steps of one row
+    acc_ref,  # (N, H) f32 running numerator
+    m_ref,  # (N, 1) f32 running max
+    l_ref,  # (N, 1) f32 running denominator
+    *,
+    sm_scale: float,
+    page_size: int,
+    n_kv: int,
+    quantized: bool,
+):
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    g = q_ref.shape[1] // n_kv
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    # absolute token index of each slot in this page; (1, ps) because TPU
+    # requires >=2D iota
+    idx = w * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    visible = idx <= pos  # (1, ps)
+
+    for j in range(n_kv):
+        kj = k_ref[0, :, j, :].astype(jnp.float32)  # (ps, H)
+        vj = v_ref[0, :, j, :].astype(jnp.float32)
+        if quantized:
+            kj = kj * ks_ref[0, j]
+            vj = vj * vs_ref[0, j]
+        qj = q_ref[0, j * g : (j + 1) * g, :].astype(jnp.float32)  # (g, H)
+        s = (
+            jax.lax.dot_general(
+                qj, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )  # (g, ps)
+        s = jnp.where(visible, s, -1e30)
+
+        m_prev = m_ref[j * g : (j + 1) * g, :]  # (g, 1)
+        l_prev = l_ref[j * g : (j + 1) * g, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)  # (g, 1)
+        # mask p itself, not just the logits: if every slot of a page is
+        # hidden, exp(-1e30 - m) could still round to nonzero garbage
+        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)  # (g, ps)
+        m_ref[j * g : (j + 1) * g, :] = m_new
+        l_ref[j * g : (j + 1) * g, :] = l_prev * alpha + jnp.sum(
+            p, axis=1, keepdims=True
+        )
+        acc_ref[j * g : (j + 1) * g, :] = acc_ref[
+            j * g : (j + 1) * g, :
+        ] * alpha + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(w == n_pages - 1)
+    def _emit():
+        o_ref[0, :, :] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused single-token decode attention straight out of the page pool.
+
+    One Pallas launch over grid ``(B, W)``: the block table rides in as a
+    scalar-prefetch operand, so each grid step's BlockSpec index map picks
+    the pool page ``bt[b, w]`` and the DMA engine streams exactly the pages
+    each row owns — the gathered ``(B, W*ps, n_kv, H)`` cache copy of
+    :func:`paged_cached_attention` never exists in HBM.  Scores stay in
+    registers/VMEM as flash-style online-softmax state (running max ``m``,
+    denominator ``l``, numerator ``acc`` carried across the W steps of a
+    row), so the ``(B, N, 1, S_kv)`` score matrix never exists either.
+
+    With ``k_scale``/``v_scale`` the pool is int8 and each page is
+    dequantized in VMEM by its own ``(page, kv_head)`` scale after the DMA —
+    HBM traffic per cached token drops to 1 byte per element plus the
+    per-page scales.
+
+    ``q`` is ``(B, 1, N, H)`` (decode only; chunked prefill keeps the naive
+    arm), ``positions`` ``(B,)`` or ``(B, 1)``.  Returns ``(B, 1, N, H)``
+    in ``q.dtype``; math is f32 like every decode path here.  Off-TPU use
+    ``interpret=True`` (differential tests); numerics match the naive arm
+    to f32 tolerance, not bitwise — online softmax sums in a different
+    order.
+    """
+    B, T, N, H = q.shape
+    if T != 1:
+        raise ValueError(f"paged_decode_attention is decode-only (T=1), got T={T}")
+    num_pages, page_size, n_kv, _ = pool_k.shape
+    W = block_tables.shape[1]
+    if N % n_kv:
+        raise ValueError(f"num_heads={N} must divide by kv_heads={n_kv}")
+    if scale is None:
+        scale = H**-0.5
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if quantized:
+        ks = k_scale.astype(jnp.float32)
+        vs = v_scale.astype(jnp.float32)
+    else:
+        # constant-folded away; keeps one kernel signature for both flavors
+        ks = jnp.ones((num_pages, n_kv), jnp.float32)
+        vs = ks
+
+    q3 = q.reshape(B, N, H)
+    bt = block_tables.astype(jnp.int32)
+    pos = jnp.broadcast_to(positions.reshape(B, -1)[:, :1], (B, 1)).reshape(B)
+    pos = pos.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        sm_scale=float(scale),
+        page_size=page_size,
+        n_kv=n_kv,
+        quantized=quantized,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, W),
+        in_specs=[
+            pl.BlockSpec((1, N, H), lambda b, w, bt, pos: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, n_kv, H), lambda b, w, bt, pos: (bt[b, w], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size, n_kv, H), lambda b, w, bt, pos: (bt[b, w], 0, 0, 0)
+            ),
+            pl.BlockSpec((1, n_kv), lambda b, w, bt, pos: (bt[b, w], 0)),
+            pl.BlockSpec((1, n_kv), lambda b, w, bt, pos: (bt[b, w], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, N, H), lambda b, w, bt, pos: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((N, 1), jnp.float32),
+            pltpu.VMEM((N, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, N, H), q.dtype),
+        interpret=interpret,
+    )(bt, pos, q3, pool_k, pool_v, ks, vs)
+    return out.reshape(B, T, N, H)
 
 
 def dot_product_attention(

@@ -1,0 +1,201 @@
+"""Shape-aware dispatch for cached/paged attention.
+
+The serving stack has three ways to attend a query against the KV cache,
+and the right one depends on ``(B, S, S_kv, heads, page_size)`` the same
+way the LoRA composite depends on (M, K, N, r) — *Run LoRA Run* roofline
+territory, in the :mod:`relora_tpu.ops.lora_dispatch` mold:
+
+- **naive** — :func:`relora_tpu.ops.attention.paged_cached_attention` /
+  ``cached_attention``: gather (paged) then masked einsum softmax einsum.
+  Always available, any S, the differential oracle.  Pays HBM for the
+  gathered cache copy *and* the ``(B, heads, S, S_kv)`` score matrix.
+- **flash** — the Pallas flash kernel via ``dot_product_attention``:
+  O(seq) memory for the pure causal self-attention case (prefill from
+  scratch, S == S_kv, 128-aligned).  Not applicable to cache-visibility
+  masking, so it never serves the paged pool — it is modeled here so one
+  cost table ranks every attention arm the repo has.
+- **paged_decode** — :func:`relora_tpu.ops.attention.paged_decode_attention`:
+  single-token decode straight out of the page pool through the block
+  table, one launch, no gathered copy, no score matrix, optional in-VMEM
+  int8 dequant.  TPU-only for auto (the interpreter is a correctness tool).
+
+:func:`choose_arm` ranks arms with the same ``t(arm) = max(bytes/BW,
+flops/peak) + launches·t_launch`` roofline over static python ints
+(``lru_cache``-d — no tracing, no retraces).  :func:`paged_attention` is
+the execution entry used by the model cache-write path; forcing ``arm=``
+bypasses the cost model (how CPU tests pin each arm).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from relora_tpu.ops.attention import (
+    flash_block_size,
+    paged_cached_attention,
+    paged_decode_attention,
+)
+
+# Shared roofline constants (see lora_dispatch for provenance: only ratios
+# matter for ranking, so v5e numbers rank correctly on CPU too).
+from relora_tpu.ops.lora_dispatch import (
+    HBM_BW_BYTES,
+    LAUNCH_OVERHEAD_S,
+    PEAK_FLOPS,
+)
+
+__all__ = [
+    "ARMS",
+    "estimate_arm_times",
+    "choose_arm",
+    "paged_attention",
+]
+
+ARMS: Tuple[str, ...] = ("naive", "flash", "paged_decode")
+
+_F32 = 4  # score/softmax math is f32 in every arm
+
+
+@functools.lru_cache(maxsize=4096)
+def estimate_arm_times(
+    B: int,
+    S: int,
+    S_kv: int,
+    heads: int,
+    kv_heads: int,
+    head_dim: int,
+    page_size: int,
+    kv_bytes: int = 2,
+    act_bytes: int = 4,
+) -> Dict[str, float]:
+    """Modeled seconds per arm for one attention of the given shape.
+
+    ``kv_bytes`` is the *stored* cache width (2 for bf16 pools, 1 for int8
+    codes), ``act_bytes`` the activation width of q/out.  The model is
+    deliberately coarse — decode attention is bandwidth-bound, so what
+    matters is how many times each arm moves the ``S_kv`` cache tokens and
+    the ``S × S_kv`` score matrix through HBM:
+
+    - naive: pool read + gathered-copy write + gathered-copy read (3× the
+      cache bytes; the paged gather materializes), scores written and
+      re-read twice (logits→softmax→probs) at f32, ~6 dispatched ops.
+    - flash: q/k/v/out each moved once, no score matrix, one launch.
+    - paged_decode: pool + scales moved once, q/out once, no gathered copy,
+      no score matrix, one launch.
+    """
+
+    def roofline(nbytes: float, flops: float, launches: int) -> float:
+        return max(nbytes / HBM_BW_BYTES, flops / PEAK_FLOPS) + launches * LAUNCH_OVERHEAD_S
+
+    qo_bytes = 2.0 * B * S * heads * head_dim * act_bytes  # q read + out write
+    cache_bytes = 2.0 * B * S_kv * kv_heads * head_dim * kv_bytes  # K and V
+    scale_bytes = 2.0 * B * (S_kv / max(page_size, 1)) * kv_heads * _F32
+    score_bytes = float(B) * heads * S * S_kv * _F32
+    flops = 4.0 * B * S * S_kv * heads * head_dim  # QK^T + PV
+
+    gathered_f32 = 2.0 * B * S_kv * kv_heads * head_dim * _F32
+    dequant_extra = gathered_f32 if kv_bytes == 1 else 0.0
+    naive = roofline(
+        qo_bytes
+        + cache_bytes  # pool read (gather source)
+        + 2.0 * gathered_f32  # gathered copy written then re-read (f32 math)
+        + dequant_extra  # int8: separate dequant pass writes f32 copy again
+        + 4.0 * score_bytes,  # logits w+r, probs w+r
+        flops,
+        6,
+    )
+
+    flash = roofline(qo_bytes + cache_bytes, flops, 1)
+
+    paged_decode = roofline(qo_bytes + cache_bytes + scale_bytes, flops, 1)
+
+    return {"naive": naive, "flash": flash, "paged_decode": paged_decode}
+
+
+@functools.lru_cache(maxsize=4096)
+def choose_arm(
+    B: int,
+    S: int,
+    S_kv: int,
+    heads: int,
+    kv_heads: int,
+    head_dim: int,
+    page_size: int,
+    kv_bytes: int = 2,
+    fused_available: bool = True,
+    allow: Tuple[str, ...] = ARMS,
+) -> str:
+    """Pick the cheapest *applicable* arm under the roofline model.
+
+    Applicability is structural, not modeled: ``paged_decode`` only exists
+    for single-token decode (S == 1); ``flash`` only for pure causal
+    self-attention with 128-aligned lengths (S == S_kv, tileable) — the
+    cache-visibility mask of chunked prefill is not expressible in it.
+    ``fused_available=False`` (non-TPU backend, or caller opt-out) strikes
+    both Pallas arms; ``allow`` restricts the candidate set (tests pin
+    arms with it).  Pure python over static ints — trace-safe.
+    """
+    times = estimate_arm_times(
+        B, S, S_kv, heads, kv_heads, head_dim, page_size, kv_bytes
+    )
+    candidates = [arm for arm in allow if arm in ARMS]
+    if S != 1 or not fused_available:
+        candidates = [a for a in candidates if a != "paged_decode"]
+    if S != S_kv or flash_block_size(S, S_kv) is None or not fused_available:
+        candidates = [a for a in candidates if a != "flash"]
+    if not candidates:
+        return "naive"
+    return min(candidates, key=lambda arm: times[arm])
+
+
+def paged_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    arm: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Attend ``q`` against the page pool via the chosen arm.
+
+    The execution entry point used by the model cache-write path
+    (models/llama.attend_with_paged_cache).  ``arm="auto"`` consults
+    :func:`choose_arm` with the static trace-time shapes; chunked prefill
+    (T > 1) always resolves to the naive arm, single-token decode takes the
+    fused kernel on TPU.  Explicit ``arm=`` bypasses the model; the flash
+    arm is not servable from a pool and is rejected here.
+    """
+    if arm not in ("auto", "naive", "paged_decode"):
+        raise ValueError(
+            f"unknown/unservable arm {arm!r}; expected auto|naive|paged_decode"
+        )
+    B, T, N, H = q.shape
+    _, page_size, n_kv, _ = pool_k.shape
+    S_kv = block_tables.shape[1] * page_size
+    if arm == "auto":
+        fused_ok = jax.default_backend() == "tpu"
+        arm = choose_arm(
+            B, T, S_kv, N, n_kv, H, page_size,
+            jnp.dtype(pool_k.dtype).itemsize,
+            fused_available=fused_ok, allow=("naive", "paged_decode"),
+        )
+    if arm == "paged_decode":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return paged_decode_attention(
+            q, pool_k, pool_v, block_tables, positions,
+            k_scale=k_scale, v_scale=v_scale, scale=scale, interpret=interpret,
+        )
+    return paged_cached_attention(
+        q, pool_k, pool_v, block_tables, positions,
+        k_scale=k_scale, v_scale=v_scale, scale=scale,
+    )

@@ -40,6 +40,42 @@ def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Ar
 
 
 # ---------------------------------------------------------------------------
+# int8 KV-cache pages (serving): per-page, per-kv-head symmetric absmax
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv_page(kv: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``(..., page_size, n_kv, head_dim)`` float -> (int8 codes, f32 scales).
+
+    One symmetric absmax scale per ``(page, kv_head)``: the scale reduces
+    over the token (page_size) and head_dim axes, so codes shape matches the
+    input and scales drop those two axes — ``(..., n_kv)``.  K and V
+    statistics differ per head but are stable within a page (16 consecutive
+    tokens of one request), which is why this granularity holds greedy token
+    parity while costing ``n_kv`` floats per page against
+    ``page_size x n_kv x head_dim`` bytes of codes.
+
+    The serving write path (models/llama.attend_with_paged_cache) maintains
+    the same scales *incrementally* — pages fill one chunk or decode token at
+    a time — as a running max with in-place requantization of the already
+    written codes whenever a page's absmax grows; this function is the
+    whole-page reference those writes must agree with, and the round-trip
+    error-bound oracle for tests.
+    """
+    kv32 = kv.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(kv32), axis=(-3, -1))  # (..., n_kv)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(kv32 / scale[..., None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_kv_page(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_kv_page`: codes ``(..., page_size, n_kv,
+    head_dim)`` x scales ``(..., n_kv)`` -> float pages in ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None, :, None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # NF4: 4-bit NormalFloat (QLoRA) with blockwise scales + double quantization
 # ---------------------------------------------------------------------------
 

@@ -83,6 +83,7 @@ def build_decode_model(
     lora: Optional[LoraSpec] = None,
     page_size: int = 0,
     num_pages: int = 0,
+    kv_dtype: str = "bf16",
 ):
     """The serving twin of train.trainer.build_model: same family dispatch,
     decode cache enabled, no remat.  ``lora=None`` (the default) serves a
@@ -111,6 +112,7 @@ def build_decode_model(
         cache_size=cache_size,
         page_size=page_size,
         num_pages=num_pages,
+        kv_dtype=kv_dtype,
     )
     if model_cfg.family == "llama":
         from relora_tpu.models.llama import LlamaForCausalLM
@@ -147,9 +149,19 @@ class InferenceEngine:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         chunk_size: int = 64,
+        kv_dtype: str = "bf16",
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}")
+        if kv_dtype == "int8" and page_size is None:
+            raise ValueError("kv_dtype='int8' requires the paged engine (page_size set)")
+        # "bf16" means the pool stores at the engine compute dtype
+        # (unquantized — bf16 in the serving default, f32 in CPU tests, so
+        # the bitwise paged-vs-contiguous parity invariant is untouched);
+        # "int8" stores codes + per-(page, kv_head) f32 scales
+        self.kv_dtype = kv_dtype
         self.config = model_cfg
         self.cache_size = cache_size
         self.mesh = mesh
@@ -244,6 +256,7 @@ class InferenceEngine:
                 lora=lora,
                 page_size=self.page_size,
                 num_pages=self.num_pages,
+                kv_dtype=kv_dtype,
             )
 
             def prefill_chunk_fn(p, ids, positions, pool, block_tables):
@@ -359,6 +372,23 @@ class InferenceEngine:
         )
         return variables["cache"]
 
+    def pool_bytes(self) -> int:
+        """Resident bytes of the shared K/V page pool — codes plus (int8)
+        the per-page scale leaves.  The ``serve/kv_cache_bytes`` gauge."""
+        self._require_paged()
+        return sum(
+            int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(self.pool_shapes())
+        )
+
+    def kv_bytes_per_token(self) -> float:
+        """Pool bytes amortized per cacheable token position
+        (``num_pages × page_size`` across the whole pool) — the
+        ``serve/kv_bytes_per_token`` gauge.  ~2×heads×head_dim×itemsize per
+        layer; int8 roughly quarters it against an f32 pool."""
+        self._require_paged()
+        return self.pool_bytes() / float(self.num_pages * self.page_size)
+
     def init_pool(self) -> PyTree:
         """Concrete zero page pool.  Replicated under a mesh (the pool has
         no batch axis to shard; K/V heads stay replicated like the ``kv``
@@ -459,6 +489,7 @@ class InferenceEngine:
             return {
                 "batch": batch,
                 "prompt_buckets": [],
+                "kv_dtype": self.kv_dtype,
                 "shapes": {
                     "prefill_chunk": [1, self.chunk_size],
                     "decode_paged": [batch, 1],

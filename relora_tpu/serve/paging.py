@@ -62,15 +62,21 @@ class PageAllocator:
     retries after pages free up.  ``incref``/``decref`` implement sharing
     (prefix cache): a page returns to the free list only when its last
     reference drops.
+
+    ``page_bytes`` is the resident HBM one page costs across every layer —
+    codes plus, for an int8 pool, its per-page scales (the scheduler passes
+    ``engine.pool_bytes() / num_pages``).  It only feeds the ``used_bytes``
+    / ``free_bytes`` accounting views; allocation itself counts pages.
     """
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int, page_bytes: int = 0):
         if num_pages < 2:
             raise ValueError(f"num_pages must be >= 2 (page 0 is reserved), got {num_pages}")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.num_pages = num_pages
         self.page_size = page_size
+        self.page_bytes = page_bytes
         # stack: pop() hands out low page ids first (cosmetic, but makes the
         # allocation order deterministic for tests and debugging)
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
@@ -84,6 +90,15 @@ class PageAllocator:
     @property
     def used_pages(self) -> int:
         return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def used_bytes(self) -> int:
+        """HBM held by allocated pages (0 when ``page_bytes`` unset)."""
+        return self.used_pages * self.page_bytes
+
+    @property
+    def free_bytes(self) -> int:
+        return self.free_pages * self.page_bytes
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """Pop ``n`` pages, all-or-nothing.  Returns None when fewer than

@@ -596,7 +596,11 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 "PagedContinuousBatchingScheduler needs an engine built with "
                 "page_size/num_pages (got a contiguous InferenceEngine)"
             )
-        self.allocator = PageAllocator(engine.num_pages, engine.page_size)
+        self.allocator = PageAllocator(
+            engine.num_pages,
+            engine.page_size,
+            page_bytes=engine.pool_bytes() // engine.num_pages,
+        )
         self.prefix_cache = (
             PrefixCache(self.allocator, max_entries=prefix_cache_entries)
             if prefix_cache
@@ -609,6 +613,10 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         self._admit_seq = 0  # admission order, drives chunk scheduling (FIFO)
         self._pad_tokens = 0  # chunk padding written, cumulative
         self._prefill_tokens = 0  # real prompt tokens written, cumulative
+        # static for the engine's lifetime (pool shapes never change): the
+        # serve/kv_cache_bytes and serve/kv_bytes_per_token gauges
+        self._kv_cache_bytes = engine.pool_bytes()
+        self._kv_bytes_per_token = engine.kv_bytes_per_token()
 
     # -- admission ------------------------------------------------------------
 
@@ -783,6 +791,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             self.obs_registry.set_gauge("kv_pages_free", self.allocator.free_pages)
             self.obs_registry.set_gauge("prefix_cache_hit_rate", hit_rate)
             self.obs_registry.set_gauge("prefill_pad_share", pad_share)
+            self.obs_registry.set_gauge("kv_cache_bytes", self._kv_cache_bytes)
+            self.obs_registry.set_gauge("kv_bytes_per_token", self._kv_bytes_per_token)
         for slot_idx, slot in enumerate(self._slots):
             if slot is None or not slot.decoding:
                 continue
@@ -807,6 +817,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                     "serve/kv_pages_free": self.allocator.free_pages,
                     "serve/prefix_cache_hit_rate": round(hit_rate, 4),
                     "serve/prefill_pad_share": round(pad_share, 4),
+                    "serve/kv_cache_bytes": self._kv_cache_bytes,
+                    "serve/kv_bytes_per_token": round(self._kv_bytes_per_token, 4),
                     "compile/steady_state_retraces": (
                         watcher.steady_state_retraces if watcher is not None else 0
                     ),
@@ -838,6 +850,10 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             "kv_pages_used": self.allocator.used_pages,
             "kv_pages_free": self.allocator.free_pages,
             "kv_pages_peak": self.allocator.peak_used,
+            "kv_dtype": self.engine.kv_dtype,
+            "kv_cache_bytes": self._kv_cache_bytes,
+            "kv_bytes_per_token": round(self._kv_bytes_per_token, 4),
+            "kv_used_bytes": self.allocator.used_bytes,
             "prefill_pad_share": round(
                 self._pad_tokens / max(self._pad_tokens + self._prefill_tokens, 1), 4
             ),

@@ -161,7 +161,7 @@ python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
 PAGED_PID=$!
 for _ in $(seq 300); do [ -s "$WORK/paged_port" ] && break; sleep 0.2; done
 [ -s "$WORK/paged_port" ] || { echo "paged server never wrote its port"; kill "$PAGED_PID"; exit 1; }
-python - "$(cat "$WORK/paged_port")" <<'EOF'
+python - "$(cat "$WORK/paged_port")" "$WORK/paged_tokens.json" <<'EOF'
 import json, sys, urllib.request
 port = sys.argv[1]
 health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
@@ -189,12 +189,58 @@ health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", ti
 paging = health["paging"]
 assert paging["kv_pages_used"] > 0, paging  # prefix entries hold pages
 assert paging["prefix_cache"]["hits"] >= 1, paging
+json.dump(first, open(sys.argv[2], "w"))  # 9c compares the int8 pool to these
 print("paged HTTP OK:", first, "| paging:", paging)
 EOF
 kill -TERM "$PAGED_PID"
 wait "$PAGED_PID"
 grep -q "serve/kv_pages_used" "$WORK/paged_run/metrics.jsonl"
 grep -q "serve/prefix_cache_hit_rate" "$WORK/paged_run/metrics.jsonl"
+
+echo "=== 9c. int8 paged KV server (quantized pool, greedy token parity vs 9b) ==="
+rm -f "$WORK/int8_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/int8_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 6 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --kv-dtype int8 \
+    --run-dir "$WORK/int8_run" &
+INT8_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/int8_port" ] && break; sleep 0.2; done
+[ -s "$WORK/int8_port" ] || { echo "int8 server never wrote its port"; kill "$INT8_PID"; exit 1; }
+python - "$(cat "$WORK/int8_port")" "$WORK/paged_tokens.json" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+assert health["status"] == "ok", health
+paging = health["paging"]
+assert paging["kv_dtype"] == "int8", paging
+# int8 codes + per-page scales undercut half the unquantized pool bytes
+assert paging["kv_bytes_per_token"] > 0, paging
+
+def generate(prompt):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 6}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+    final = json.loads(events[-2])
+    assert final["finish_reason"] == "length" and len(final["tokens"]) == 6, final
+    return final["tokens"]
+
+# the 9b prompts again: greedy decode from the quantized pool must produce
+# the exact tokens the unquantized pool produced
+want = json.load(open(sys.argv[2]))
+long_prompt = [(i % 100) + 1 for i in range(40)]
+got = generate(long_prompt)
+assert got == want, f"int8 diverged from bf16 pool: {got} != {want}"
+assert generate(long_prompt) == want, "int8 prefix-cache replay diverged"
+print("int8 paged HTTP OK:", got, "| kv_bytes_per_token:", paging["kv_bytes_per_token"])
+EOF
+kill -TERM "$INT8_PID"
+wait "$INT8_PID"
+grep -q "serve/kv_cache_bytes" "$WORK/int8_run/metrics.jsonl"
+grep -q "serve/kv_bytes_per_token" "$WORK/int8_run/metrics.jsonl"
 
 echo "=== 10. traced run + SIGTERM flight dump (obs subsystem) ==="
 # fault injection fires a real SIGTERM at update 4; the PreemptionGuard

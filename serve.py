@@ -97,6 +97,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--chunk-size", type=int, default=64, help="paged: prefill chunk length")
     p.add_argument(
+        "--kv-dtype",
+        choices=("bf16", "int8"),
+        default="bf16",
+        help="paged: KV pool storage — bf16 stores at the compute dtype, "
+        "int8 quantizes pages (per-page/kv-head absmax scales, ~half the "
+        "pool HBM, so ~double the pages per chip; docs/serving.md)",
+    )
+    p.add_argument(
         "--no-prefix-cache",
         action="store_true",
         help="paged: disable shared-prefix page reuse",
@@ -213,7 +221,11 @@ def main(argv=None) -> int:
             page_size=args.page_size,
             num_pages=num_pages,
             chunk_size=args.chunk_size,
+            kv_dtype=args.kv_dtype,
         )
+    elif args.kv_dtype != "bf16":
+        p_err = "--kv-dtype int8 requires --paged (the contiguous cache is unquantized)"
+        raise SystemExit(p_err)
     engine = InferenceEngine(
         model_cfg,
         params,

@@ -1,0 +1,171 @@
+"""Fused paged-decode attention kernel + attention dispatch.
+
+The kernel (ops/attention.paged_decode_attention) reads the page pool
+directly through the block table — no gathered cache copy, no
+``(B, heads, 1, S_kv)`` score matrix in HBM — so its only oracle is the
+naive gather arm (ops/attention.paged_cached_attention), which these tests
+hold it to in Pallas interpret mode on CPU, for bf16-stored and
+int8-quantized pools.  The dispatcher tests mirror tests/test_lora_kernels:
+dispatch changes the compute graph, never the result, and never picks the
+interpreter on a non-TPU backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.ops.attention import (
+    paged_cached_attention,
+    paged_decode_attention,
+)
+from relora_tpu.ops.attention_dispatch import (
+    ARMS,
+    choose_arm,
+    estimate_arm_times,
+    paged_attention,
+)
+from relora_tpu.ops.quant import quantize_kv_page
+
+
+def _max_err(a, b):
+    return float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
+
+
+def _pool_case(seed, *, B=2, heads=4, kv_heads=2, head_dim=8, page_size=4, W=3):
+    """A decode step against a shared pool: every row owns W pages, rows sit
+    at staggered positions (ragged visibility), and unallocated pool pages
+    hold garbage that only the mask keeps out of the result."""
+    key = jax.random.PRNGKey(seed)
+    num_pages = B * W + 3  # + null page + 2 never-referenced garbage pages
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (B, 1, heads, head_dim), jnp.float32)
+    pool_k = jax.random.normal(ks[1], (num_pages, page_size, kv_heads, head_dim))
+    pool_v = jax.random.normal(ks[2], (num_pages, page_size, kv_heads, head_dim))
+    # rows own disjoint pages, deliberately not in pool order
+    perm = np.random.default_rng(seed).permutation(B * W) + 1
+    bt = jnp.asarray(perm.reshape(B, W), jnp.int32)
+    # staggered positions: row 0 has a single visible token, last row is full
+    pos = jnp.linspace(0, W * page_size - 1, B).astype(jnp.int32).reshape(B, 1)
+    return q, pool_k, pool_v, bt, pos
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_decode_matches_naive_bf16_pool(seed):
+    q, pk, pv, bt, pos = _pool_case(seed)
+    want = paged_cached_attention(q, pk, pv, bt, pos)
+    got = paged_decode_attention(q, pk, pv, bt, pos, interpret=True)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    assert _max_err(got, want) < 1e-5
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_decode_matches_naive_int8_pool(seed):
+    q, pk, pv, bt, pos = _pool_case(seed)
+    qk, k_scale = quantize_kv_page(pk)
+    qv, v_scale = quantize_kv_page(pv)
+    want = paged_cached_attention(q, qk, qv, bt, pos, k_scale=k_scale, v_scale=v_scale)
+    got = paged_decode_attention(
+        q, qk, qv, bt, pos, k_scale=k_scale, v_scale=v_scale, interpret=True
+    )
+    assert _max_err(got, want) < 1e-5
+    # and the int8 arm sits near the float result (quantization error only)
+    ref = paged_cached_attention(q, pk, pv, bt, pos)
+    assert _max_err(got, ref) < 0.05
+
+
+def test_fused_decode_gqa_and_custom_scale():
+    """Grouped heads (heads > kv_heads) with an explicit softmax scale."""
+    q, pk, pv, bt, pos = _pool_case(3, heads=8, kv_heads=2, head_dim=16)
+    want = paged_cached_attention(q, pk, pv, bt, pos, scale=0.5)
+    got = paged_decode_attention(q, pk, pv, bt, pos, scale=0.5, interpret=True)
+    assert _max_err(got, want) < 1e-5
+
+
+def test_fused_decode_position_zero_row():
+    """A row at position 0 (one visible token) must not NaN — the online
+    softmax sees exactly one unmasked entry at w=0."""
+    q, pk, pv, bt, pos = _pool_case(4)
+    pos = jnp.zeros_like(pos)
+    got = paged_decode_attention(q, pk, pv, bt, pos, interpret=True)
+    want = paged_cached_attention(q, pk, pv, bt, pos)
+    assert np.isfinite(np.asarray(got)).all()
+    assert _max_err(got, want) < 1e-5
+
+
+def test_fused_decode_rejects_multi_token_query():
+    q, pk, pv, bt, pos = _pool_case(5)
+    q2 = jnp.concatenate([q, q], axis=1)  # T=2
+    with pytest.raises(ValueError, match="decode-only"):
+        paged_decode_attention(q2, pk, pv, bt, pos, interpret=True)
+
+
+def test_fused_decode_requires_both_scales():
+    q, pk, pv, bt, pos = _pool_case(6)
+    qk, k_scale = quantize_kv_page(pk)
+    with pytest.raises(ValueError, match="k_scale"):
+        paged_decode_attention(q, qk, pv, bt, pos, k_scale=k_scale, interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# dispatch (ops/attention_dispatch) — lora_dispatch mold
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_arm_times_sane():
+    t = estimate_arm_times(4, 1, 2048, 32, 8, 128, 16)
+    assert set(t) == set(ARMS)
+    assert all(v > 0 for v in t.values())
+    # the fused arm moves strictly fewer bytes with fewer launches
+    assert t["paged_decode"] < t["naive"]
+    # int8 halves the cache traffic, so the fused estimate drops further
+    t8 = estimate_arm_times(4, 1, 2048, 32, 8, 128, 16, kv_bytes=1)
+    assert t8["paged_decode"] < t["paged_decode"]
+
+
+def test_choose_arm_regimes():
+    # single-token decode on TPU -> fused kernel
+    assert choose_arm(4, 1, 2048, 32, 8, 128, 16) == "paged_decode"
+    # same shape, fused unavailable (CPU) -> naive
+    assert choose_arm(4, 1, 2048, 32, 8, 128, 16, fused_available=False) == "naive"
+    # pure causal prefill, 128-aligned -> flash
+    assert choose_arm(1, 512, 512, 32, 8, 128, 16) == "flash"
+    # chunked prefill (S != S_kv, S > 1): neither pallas arm applies
+    assert choose_arm(1, 64, 512, 32, 8, 128, 16) == "naive"
+    # allow= restricts the candidate set (the paged entry point never
+    # considers flash — it is not servable from a pool)
+    assert choose_arm(1, 512, 512, 32, 8, 128, 16, allow=("naive", "paged_decode")) == "naive"
+
+
+def test_auto_never_interprets_on_cpu():
+    """On a non-TPU backend, arm="auto" must not pick the fused interpreter."""
+    assert jax.default_backend() != "tpu"
+    arm = choose_arm(
+        4, 1, 2048, 32, 8, 128, 16, fused_available=jax.default_backend() == "tpu"
+    )
+    assert arm != "paged_decode"
+
+
+@pytest.mark.parametrize("quantized", [False, True], ids=["bf16", "int8"])
+def test_dispatch_never_changes_numerics(quantized):
+    """Every servable arm (and auto) produces the same value within
+    tolerance — dispatch changes the compute graph, never the result."""
+    q, pk, pv, bt, pos = _pool_case(7)
+    kw = {}
+    if quantized:
+        pk, k_scale = quantize_kv_page(pk)
+        pv, v_scale = quantize_kv_page(pv)
+        kw = {"k_scale": k_scale, "v_scale": v_scale}
+    want = paged_cached_attention(q, pk, pv, bt, pos, **kw)
+    for arm in ("naive", "paged_decode", "auto"):
+        got = paged_attention(q, pk, pv, bt, pos, arm=arm, interpret=True, **kw)
+        assert _max_err(got, want) < 1e-5, f"arm={arm}"
+    # auto on CPU resolves to the naive arm: bitwise-identical, no interpreter
+    auto = paged_attention(q, pk, pv, bt, pos, arm="auto", **kw)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(want))
+
+
+def test_dispatch_rejects_unknown_arm():
+    q, pk, pv, bt, pos = _pool_case(8)
+    with pytest.raises(ValueError, match="unknown/unservable"):
+        paged_attention(q, pk, pv, bt, pos, arm="flash")

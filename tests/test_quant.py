@@ -413,6 +413,66 @@ def test_nf4_end_to_end_training(tmp_path):
     assert np.isfinite(res["final_eval_loss"])
 
 
+# ---------------------------------------------------------------------------
+# Int8 paged KV cache (serving): per-(page, kv_head) symmetric quantization
+# ---------------------------------------------------------------------------
+
+from relora_tpu.ops.quant import dequantize_kv_page, quantize_kv_page  # noqa: E402
+
+
+def test_kv_page_roundtrip_error_bound():
+    """Reconstruction error of every element is bounded by half a
+    quantization step (scale/2 with scale = absmax/127) — the per-element
+    property the serving quality triage (docs/operations.md) relies on.
+    Magnitudes vary 5 decades across pages and kv heads to exercise the
+    per-(page, head) scale granularity."""
+    key = jax.random.PRNGKey(0)
+    kv = jax.random.normal(key, (6, 8, 4, 16))
+    mags = 10.0 ** jax.random.uniform(
+        jax.random.fold_in(key, 1), (6, 1, 4, 1), minval=-3.0, maxval=2.0
+    )
+    kv = kv * mags
+    q, s = quantize_kv_page(kv)
+    assert q.dtype == jnp.int8 and s.shape == (6, 4) and s.dtype == jnp.float32
+    back = dequantize_kv_page(q, s)
+    bound = np.asarray(s)[:, None, :, None] * 0.5 + 1e-9
+    assert (np.abs(np.asarray(back - kv)) <= bound).all()
+    # all-zero pages round-trip to exactly zero (the scale floor avoids 0/0)
+    q0, s0 = quantize_kv_page(jnp.zeros((2, 8, 4, 16)))
+    assert float(jnp.abs(dequantize_kv_page(q0, s0)).max()) == 0.0
+
+
+def test_kv_incremental_write_tracks_whole_page_oracle():
+    """The serving write path (attend_with_paged_cache) grows a page's scale
+    monotonically and requantizes that page's existing codes whenever it
+    does.  Filling a page token-by-token with growing magnitudes (worst case
+    for the running max: every write forces a requant) must land within a
+    small multiple of the one-shot whole-page error, and the final running
+    scale must equal the whole-page oracle's."""
+    key = jax.random.PRNGKey(2)
+    ps, n_kv, H = 8, 2, 16
+    kv = jax.random.normal(key, (ps, n_kv, H)) * (1.0 + jnp.arange(ps)[:, None, None])
+    codes = jnp.zeros((ps, n_kv, H), jnp.int8)
+    scale = jnp.zeros((n_kv,))
+    for t in range(ps):
+        new = kv[t]
+        cand = jnp.maximum(jnp.max(jnp.abs(new), axis=-1) / 127.0, 1e-12)
+        new_scale = jnp.maximum(scale, cand)
+        ratio = scale / new_scale
+        codes = jnp.clip(
+            jnp.round(codes.astype(jnp.float32) * ratio[None, :, None]), -127, 127
+        ).astype(jnp.int8)
+        q_new = jnp.clip(jnp.round(new / new_scale[:, None]), -127, 127).astype(jnp.int8)
+        codes = codes.at[t].set(q_new)
+        scale = new_scale
+    back = codes.astype(jnp.float32) * scale[None, :, None]
+    q1, s1 = quantize_kv_page(kv[None])
+    np.testing.assert_allclose(np.asarray(scale), np.asarray(s1[0]), rtol=1e-6)
+    one_shot = float(jnp.abs(dequantize_kv_page(q1, s1)[0] - kv).max())
+    incremental = float(jnp.abs(back - kv).max())
+    assert incremental <= 4.0 * one_shot + 1e-9, (incremental, one_shot)
+
+
 def test_pallas_quant_matmul_path_matches_default(monkeypatch):
     """RELORA_TPU_PALLAS_QUANT=1 routes the int8 base through the pallas
     kernel (interpret mode on CPU) with identical outputs."""

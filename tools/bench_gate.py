@@ -17,6 +17,13 @@ Four rules, each skipped gracefully when its input files are absent:
    and clean/kill ``ttft_p95_ms`` under the committed router caps.
 4. **obs overhead** (``BENCH_obs.json``): ``detail.within_budget`` must be
    true — the span tracer's measured overhead stayed inside its budget_pct.
+5. **attention kernel** (``BENCH_attn.json``): on TPU the fused paged-decode
+   arm must not lose to the naive gather arm by more than ``--tolerance``
+   on any decode bucket, and the roofline's ``model_choice`` must agree
+   with ``measured_best`` on the arm family.  Skipped entirely when the
+   artifact was recorded in interpreter mode (``detail.is_interpret`` —
+   off-TPU the pallas arm runs the pallas interpreter, a correctness
+   record whose timings carry no performance signal).
 
 Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
 ``--warn-only`` reports failures but exits 0 — CI uses it off-TPU where the
@@ -148,6 +155,38 @@ def check_obs(bench_dir: str) -> List[str]:
     return []
 
 
+def check_attn(bench_dir: str, tolerance: float) -> List[str]:
+    doc = _load(os.path.join(bench_dir, "BENCH_attn.json"))
+    if not doc:
+        return []
+    detail = doc.get("detail") or {}
+    if detail.get("is_interpret"):
+        return []  # interpreter-mode timings carry no performance signal
+    failures = []
+    for row in detail.get("buckets") or []:
+        if row.get("kind") != "decode":
+            continue
+        shape = f"B={row.get('B')} S_kv={row.get('S_kv')}"
+        for tag in ("bf16", "int8"):
+            fused = row.get(f"paged_decode_{tag}_ms")
+            naive = row.get(f"naive_{tag}_ms")
+            if not (isinstance(fused, (int, float)) and isinstance(naive, (int, float))):
+                continue
+            if fused > naive * (1.0 + tolerance):
+                failures.append(
+                    f"attn {shape} {tag}: fused paged-decode {fused:.3f}ms is "
+                    f"{(fused / naive - 1) * 100:.0f}% slower than naive {naive:.3f}ms"
+                )
+            choice = row.get(f"model_choice_{tag}")
+            best = row.get("measured_best") or ""
+            if choice and best and not best.startswith(choice):
+                failures.append(
+                    f"attn {shape} {tag}: roofline picked {choice} but measured "
+                    f"best arm was {best}"
+                )
+    return failures
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--check", action="store_true", help="run the gate (the only mode)")
@@ -183,6 +222,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_http(args.dir, baselines)
         + check_router(args.dir, baselines)
         + check_obs(args.dir)
+        + check_attn(args.dir, args.tolerance)
     )
 
     rounds = real_rounds(args.dir)

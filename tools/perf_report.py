@@ -203,6 +203,15 @@ def print_serving(records: List[Dict[str, Any]], out) -> None:
         f"  prefix cache    hit rate {hit_rate * 100:5.1f}%\n"
         f"  prefill pad     {pads[-1] * 100:5.1f}% of chunked prefill tokens\n"
     )
+    # pool HBM footprint (static per engine; int8 pools report ~1 byte/elem
+    # of cache plus per-page scales vs 2 for bf16)
+    pool = paged_steps[-1].get("serve/kv_cache_bytes")
+    per_tok = paged_steps[-1].get("serve/kv_bytes_per_token")
+    if pool is not None:
+        out.write(
+            f"  kv pool         {fmt_bytes(pool)} resident"
+            f"  ({fmt_bytes(per_tok)}/token across layers)\n"
+        )
 
 
 def print_phases(trace_path: str, out) -> None:
