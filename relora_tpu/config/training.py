@@ -146,8 +146,9 @@ class TrainingConfig:
     bf16_logits: bool = False  # halve the logits HBM footprint; CE still f32
     loss_impl: str = "dense"  # dense | chunked (streamed vocab CE, no full logits)
     vocab_chunk: int = 8192  # chunk size for loss_impl=chunked
-    # opt-in pallas flash kernel: XLA's fused attention is the robust default
-    # (and the sandbox's remote-compile tunnel stalls on the pallas kernel)
+    # force the pallas flash kernel unconditionally (bypasses the per-shape
+    # roofline dispatch that impl="auto" runs through attention_dispatch.
+    # choose_training_arm); off = dispatch decides flash vs xla per shape
     flash_attention: bool = False
 
     # --- observability / misc ---

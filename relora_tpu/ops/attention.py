@@ -56,24 +56,6 @@ def _grouped_equal_heads_call(q, k, v, equal_heads_fn) -> jax.Array:
     return jnp.stack(outs, axis=3).reshape(B, S, n, H)
 
 
-def _pallas_min_seq() -> int:
-    """Sequence length at/above which impl='auto' prefers the pallas flash
-    kernel on TPU.  Disabled unless RELORA_TPU_PALLAS_MIN_SEQ is set: the
-    only recorded A/B has XLA beating pallas by 5% at seq 1024 on the v5e
-    (BASELINE.md r2), so until scripts/bench_attention.py has measured the
-    crossover on-chip, auto stays on the XLA fused path and the pallas
-    dispatch is explicit opt-in.  0 (or unset) disables."""
-    import os
-
-    _DISABLED = 1 << 62
-    raw = os.environ.get("RELORA_TPU_PALLAS_MIN_SEQ", "")
-    try:
-        val = int(raw)
-    except ValueError:
-        return _DISABLED
-    return val if val > 0 else _DISABLED
-
-
 def _naive_attention(q, k, v, *, causal: bool, scale: float) -> jax.Array:
     B, S, N, H = q.shape
     n_kv = k.shape[2]
@@ -443,19 +425,32 @@ def dot_product_attention(
 ) -> jax.Array:
     """Causal SDPA over ``(B, S, N, H)`` tensors.
 
-    ``impl='auto'`` resolves to the XLA fused path (which beat the pallas
-    kernel by 5% at seq 1024 on the v5e, BASELINE.md r2).  Setting
-    ``RELORA_TPU_PALLAS_MIN_SEQ=N`` opts in to the pallas flash kernel for
-    seq >= N on TPU; until the op-level A/B at 1k/4k/16k
-    (scripts/bench_attention.py) has measured a crossover on-chip there is
-    no default threshold.
+    ``impl='auto'`` resolves per shape through the roofline dispatcher
+    (:func:`relora_tpu.ops.attention_dispatch.choose_training_arm`): forward
+    + backward cost modeled for naive/xla/flash over the static trace-time
+    ``(B, S, heads, head_dim)``, the flash arm struck off-TPU or at
+    non-tileable lengths.  Forcing ``impl=`` bypasses the cost model — all
+    arms are numerically interchangeable (pinned by
+    tests/test_attention_dispatch.py), so dispatch never changes results.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl == "auto":
-        impl = "xla"
-        if q.shape[1] >= _pallas_min_seq() and jax.default_backend() == "tpu":
-            impl = "pallas"
+        if q.shape[1] != k.shape[1]:
+            impl = "xla"  # cross-attention shape: not in the training table
+        else:
+            from relora_tpu.ops.attention_dispatch import choose_training_arm
+
+            arm = choose_training_arm(
+                q.shape[0],
+                q.shape[1],
+                q.shape[2],
+                k.shape[2],
+                q.shape[3],
+                act_bytes=jnp.dtype(q.dtype).itemsize,
+                fused_available=jax.default_backend() == "tpu",
+            )
+            impl = "pallas" if arm == "flash" else arm
     if impl == "xla":
         return jax.nn.dot_product_attention(q, k, v, scale=scale, is_causal=causal)
     if impl == "pallas":

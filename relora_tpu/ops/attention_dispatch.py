@@ -24,6 +24,15 @@ flops/peak) + launches·t_launch`` roofline over static python ints
 (``lru_cache``-d — no tracing, no retraces).  :func:`paged_attention` is
 the execution entry used by the model cache-write path; forcing ``arm=``
 bypasses the cost model (how CPU tests pin each arm).
+
+:func:`choose_training_arm` is the *training/prefill* half of the same
+table: pure causal self-attention (S == S_kv) as the model forward runs it
+under autodiff, where the cost of an arm is forward **plus backward** —
+the backward pays ~2× the forward matmul FLOPs, re-materializes whatever
+the remat policy dropped, and (for the score-materializing arms) moves the
+``S × S`` matrix through HBM several more times.  ``dot_product_attention``'s
+``impl="auto"`` resolves through it, which is what retired the
+``RELORA_TPU_PALLAS_MIN_SEQ`` sequence-length threshold.
 """
 
 from __future__ import annotations
@@ -50,12 +59,19 @@ from relora_tpu.ops.lora_dispatch import (
 
 __all__ = [
     "ARMS",
+    "TRAIN_ARMS",
     "estimate_arm_times",
+    "estimate_training_arm_times",
     "choose_arm",
+    "choose_training_arm",
     "paged_attention",
 ]
 
 ARMS: Tuple[str, ...] = ("naive", "flash", "paged_decode")
+
+#: arms a training forward can execute (attention.dot_product_attention
+#: impls; "flash" maps to impl="pallas" there)
+TRAIN_ARMS: Tuple[str, ...] = ("naive", "xla", "flash")
 
 _F32 = 4  # score/softmax math is f32 in every arm
 
@@ -149,6 +165,103 @@ def choose_arm(
         candidates = [a for a in candidates if a != "flash"]
     if not candidates:
         return "naive"
+    return min(candidates, key=lambda arm: times[arm])
+
+
+@functools.lru_cache(maxsize=4096)
+def estimate_training_arm_times(
+    B: int,
+    S: int,
+    heads: int,
+    kv_heads: int,
+    head_dim: int,
+    act_bytes: int = 2,
+    with_backward: bool = True,
+) -> Dict[str, float]:
+    """Modeled seconds per arm for one *training* causal self-attention
+    (S == S_kv), forward + backward.
+
+    The decode table (:func:`estimate_arm_times`) ranks bandwidth-bound
+    single-token shapes; training shapes are compute-heavy and pay the
+    backward too, which shifts the balance:
+
+    - matmul FLOPs: 4·B·S²·h·d forward; the backward's dq/dk/dv matmuls
+      are ~2× that, and under the remat policies we train with (``dots`` /
+      ``dots_narrow`` recompute batched dots) the probs are recomputed once
+      more — modeled as a 3.5× forward multiplier for every arm.  The flash
+      kernel's grid skips fully-masked causal blocks, so its effective
+      FLOPs are ~half the dense count; XLA/naive compute the full square.
+    - HBM: every arm moves q/k/v/out once forward and ~2× more backward
+      (reads + grads).  The score-materializing arms additionally stream
+      the ``B·h·S²`` matrix — twice forward (probs write + PV read) and
+      ~twice backward for ``xla`` at activation width, double that and at
+      f32 for ``naive`` (logits→softmax→probs each written and re-read).
+      ``flash`` keeps scores in VMEM, forward and backward.
+    - launches: naive is ~6 fused ops forward + ~8 backward; the XLA fused
+      path ~2 + 4; flash is 1 forward + 2 backward kernels (dq, dkv).
+    """
+
+    def roofline(nbytes: float, flops: float, launches: int) -> float:
+        return max(nbytes / HBM_BW_BYTES, flops / PEAK_FLOPS) + launches * LAUNCH_OVERHEAD_S
+
+    bwd_flops_mult = 3.5 if with_backward else 1.0
+    bwd_io_mult = 3.0 if with_backward else 1.0
+
+    io_bytes = (
+        2.0 * B * S * heads * head_dim * act_bytes  # q + out
+        + 2.0 * B * S * kv_heads * head_dim * act_bytes  # k + v
+    )
+    score_bytes = float(B) * heads * S * S  # × itemsize below
+    flops_full = 4.0 * B * S * S * heads * head_dim
+    flops_causal = flops_full / 2.0
+
+    naive = roofline(
+        bwd_io_mult * io_bytes * 2  # f32 math: inputs upcast
+        + (8.0 if with_backward else 4.0) * score_bytes * _F32,
+        bwd_flops_mult * flops_full,
+        14 if with_backward else 6,
+    )
+    xla = roofline(
+        bwd_io_mult * io_bytes + (4.0 if with_backward else 2.0) * score_bytes * act_bytes,
+        bwd_flops_mult * flops_full,
+        6 if with_backward else 2,
+    )
+    flash = roofline(
+        bwd_io_mult * io_bytes,
+        bwd_flops_mult * flops_causal,
+        3 if with_backward else 1,
+    )
+    return {"naive": naive, "xla": xla, "flash": flash}
+
+
+@functools.lru_cache(maxsize=4096)
+def choose_training_arm(
+    B: int,
+    S: int,
+    heads: int,
+    kv_heads: int,
+    head_dim: int,
+    act_bytes: int = 2,
+    with_backward: bool = True,
+    fused_available: bool = True,
+    allow: Tuple[str, ...] = TRAIN_ARMS,
+) -> str:
+    """Cheapest applicable arm for a training/prefill causal self-attention.
+
+    Applicability mirrors :func:`choose_arm`: ``flash`` needs the Pallas
+    kernel (TPU, 128-aligned tileable S — :func:`flash_block_size`);
+    ``fused_available=False`` strikes it.  ``xla`` and ``naive`` always
+    apply.  Pure python over static trace-time ints, so the per-shape
+    choice is free and can never retrace.
+    """
+    times = estimate_training_arm_times(
+        B, S, heads, kv_heads, head_dim, act_bytes, with_backward
+    )
+    candidates = [arm for arm in allow if arm in TRAIN_ARMS]
+    if not fused_available or flash_block_size(S, S) is None:
+        candidates = [a for a in candidates if a != "flash"]
+    if not candidates:
+        return "xla"
     return min(candidates, key=lambda arm: times[arm])
 
 

@@ -46,7 +46,11 @@ LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("qkv", TENSOR_AXIS),
     ("mlp", TENSOR_AXIS),
     ("heads", TENSOR_AXIS),
-    ("kv", None),
+    # k/v projection output dim (kv_heads * head_dim) shards over tensor
+    # like the q projection, so a tp group splits attention by head end to
+    # end; the serving page pool shards its kv_heads axis to match
+    # (serve/engine.py pool_shardings)
+    ("kv", TENSOR_AXIS),
     ("seq", SEQUENCE_AXIS),
     ("lora", None),  # LoRA factors are small: replicate by default
     ("layers", None),  # scan axis stays unsharded

@@ -31,9 +31,13 @@ compiled shape, and cache HBM scales with ``num_pages``, not
 ``max_batch × cache_size``.
 
 Shardings: with a mesh, params shard per the model's logical annotations
-(parallel/mesh.py LOGICAL_RULES) and cache buffers shard their batch axis over
-``data``×``fsdp`` — K/V heads stay replicated like the ``kv`` logical axis.
-Without a mesh the same code runs single-host (CPU tests, dev boxes).
+(parallel/mesh.py LOGICAL_RULES), cache buffers shard their batch axis over
+``data``×``fsdp``, and K/V heads — contiguous cache and page pool alike —
+shard over ``tensor`` when divisible, matching the ``kv`` logical axis of
+the k/v projection kernels.  Sharding the pool by head drops per-chip pool
+bytes by the tp degree, and the engine returns that HBM as proportionally
+more pages (``num_pages`` is the per-chip page budget).  Without a mesh the
+same code runs single-host (CPU tests, dev boxes).
 """
 
 from __future__ import annotations
@@ -51,7 +55,7 @@ from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
 from relora_tpu.obs import memory as obs_memory
 from relora_tpu.obs.compile import CompileWatcher
-from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, param_shardings
+from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, param_shardings
 from relora_tpu.serve.sampling import SamplingParams, sample
 
 PyTree = Any
@@ -190,7 +194,16 @@ class InferenceEngine:
             if chunk_size < 1:
                 raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.page_size = page_size or 0
-        self.num_pages = num_pages or 0
+        # tp sharding of the pool: each tensor shard holds kv_heads/kv_shards
+        # heads of EVERY page, so per-chip pool bytes drop by kv_shards — the
+        # freed HBM is returned as kv_shards× more pages (num_pages is the
+        # per-chip page budget; the pool grows with the chips serving it)
+        self.kv_shards = 1
+        if mesh is not None and mesh.shape[TENSOR_AXIS] > 1:
+            if model_cfg.kv_heads % mesh.shape[TENSOR_AXIS] == 0:
+                self.kv_shards = mesh.shape[TENSOR_AXIS]
+        self.requested_num_pages = num_pages or 0
+        self.num_pages = (num_pages or 0) * (self.kv_shards if num_pages else 1)
         self.chunk_size = min(chunk_size, cache_size)
         self.model = build_decode_model(
             model_cfg,
@@ -279,6 +292,11 @@ class InferenceEngine:
                 )
                 return logits[:, -1, :], variables["cache"]
 
+            # the pool argument is donated AND (under a mesh) committed to
+            # pool_shardings by init_pool: jit infers the input sharding from
+            # the committed buffers, donation reuses them in place, and the
+            # output pool keeps the same placement — so the kv-head shards
+            # never move for the lifetime of the serve loop
             self._prefill_chunk = cw.wrap(
                 "prefill_chunk", jax.jit(prefill_chunk_fn, donate_argnums=(3,))
             )
@@ -298,8 +316,10 @@ class InferenceEngine:
         return variables["cache"]
 
     def cache_shardings(self, batch: int) -> Optional[PyTree]:
-        """Batch axis over data×fsdp, everything else replicated — K/V heads
-        stay unsharded like the ``kv`` logical axis in LOGICAL_RULES."""
+        """Batch axis over data×fsdp; K/V heads over tensor when divisible,
+        matching the ``kv`` logical axis the k/v projection kernels shard
+        over — the cache a tp shard writes is exactly the heads it computed,
+        so no resharding collective sits between projection and cache."""
         if self.mesh is None:
             return None
 
@@ -310,6 +330,8 @@ class InferenceEngine:
             )
             if batch % n_shards == 0:
                 axes[_cache_batch_axis(leaf)] = (DATA_AXIS, FSDP_AXIS)
+            if self.kv_shards > 1:
+                axes[leaf.ndim - 2] = TENSOR_AXIS  # (..., kv_heads, head_dim)
             return NamedSharding(self.mesh, P(*axes))
 
         return jax.tree_util.tree_map(spec, self.cache_shapes(batch))
@@ -389,13 +411,40 @@ class InferenceEngine:
         self._require_paged()
         return self.pool_bytes() / float(self.num_pages * self.page_size)
 
-    def init_pool(self) -> PyTree:
-        """Concrete zero page pool.  Replicated under a mesh (the pool has
-        no batch axis to shard; K/V heads stay replicated like the ``kv``
-        logical axis)."""
+    def pool_shardings(self) -> Optional[PyTree]:
+        """NamedSharding tree for the page pool: the kv_heads axis shards
+        over ``tensor`` when divisible (matching the ``kv`` logical axis the
+        k/v projection kernels shard over), everything else replicated.
+        Code leaves are ``(..., num_pages, page_size, kv_heads, head_dim)``
+        (kv axis at ndim-2); int8 scale leaves are ``(..., num_pages,
+        kv_heads)`` (kv axis last).  The pool has no batch axis — every
+        request's pages live on every tp shard, sliced by head."""
         self._require_paged()
+        if self.mesh is None:
+            return None
+
+        def spec(leaf):
+            axes = [None] * leaf.ndim
+            if self.kv_shards > 1:
+                axes[leaf.ndim - 2 if leaf.ndim >= 4 else leaf.ndim - 1] = TENSOR_AXIS
+            return NamedSharding(self.mesh, P(*axes))
+
+        return jax.tree_util.tree_map(spec, self.pool_shapes())
+
+    def init_pool(self) -> PyTree:
+        """Concrete zero page pool, kv-head-sharded over ``tensor`` when a
+        mesh is set (pool_shardings); the committed placement is what the
+        donated prefill_chunk/decode_paged steps inherit, so the pool never
+        leaves its shards across the whole serve loop."""
+        self._require_paged()
+        shardings = self.pool_shardings()
+        shapes = self.pool_shapes()
+        if shardings is None:
+            return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
         return jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), self.pool_shapes()
+            lambda s, sh: jax.device_put(jnp.zeros(s.shape, s.dtype), sh),
+            shapes,
+            shardings,
         )
 
     def prefill_chunk(

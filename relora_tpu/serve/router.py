@@ -39,11 +39,18 @@ fails fast with a typed terminal event —
 responses are buffered router-side and are therefore always
 retry-or-deliver-whole.
 
+**Sharded replicas.**  An endpoint value may name a *shard group* (see
+``EndpointSource``): the group's replicas are one tp/fsdp-sharded model
+instance, routable only while every shard answers ``/healthz`` (losing one
+shard loses the instance), scored by the group's summed load, and proxied
+to the group's primary (lowest rid — the shard serving HTTP).  Plain
+endpoints are singleton groups, so unsharded fleets are unchanged.
+
 Endpoints: ``POST /v1/generate`` (proxied; response carries
-``X-Relora-Replica``), ``GET /healthz`` (200 iff >= 1 routable replica,
-with per-replica state), ``GET /metrics`` (Prometheus text, namespace
-``relora_router``: request/retry/failover counters labelled by replica,
-per-replica health gauges).
+``X-Relora-Replica``), ``GET /healthz`` (200 iff >= 1 routable group, with
+per-replica and per-group state), ``GET /metrics`` (Prometheus text,
+namespace ``relora_router``: request/retry/failover counters labelled by
+replica, per-replica and per-group health gauges).
 """
 
 from __future__ import annotations
@@ -78,11 +85,17 @@ _REQUEST_TIMEOUT_S = 30.0
 
 #: endpoints: static list/dict of (host, port), or a callable returning
 #: {rid: (host, port-or-None)} — the supervisor's live view, re-read every
-#: probe round so restarted replicas (new ephemeral ports) are picked up
+#: probe round so restarted replicas (new ephemeral ports) are picked up.
+#: A value may carry a third element, the *shard group*: replicas sharing a
+#: group are one tp/fsdp-sharded model instance (the group is routable only
+#: when EVERY member answers healthz — losing one shard loses the whole
+#: instance; requests go to the group's primary, the lowest rid, which is
+#: the shard that serves HTTP).  A plain (host, port) value is its own
+#: singleton group, so unsharded fleets behave exactly as before.
 EndpointSource = Union[
-    Sequence[Tuple[str, Optional[int]]],
-    Mapping[str, Tuple[str, Optional[int]]],
-    Callable[[], Mapping[str, Tuple[str, Optional[int]]]],
+    Sequence[Tuple],
+    Mapping[str, Tuple],
+    Callable[[], Mapping[str, Tuple]],
 ]
 
 
@@ -179,6 +192,7 @@ class ReplicaState:
     host: str
     port: Optional[int]  # None: no port file yet (down / restarting)
     breaker: CircuitBreaker
+    group: str = ""  # shard group; "" = singleton group of just this replica
     healthy: bool = False
     status: str = "unknown"  # last healthz status string, or "unreachable"/"down"
     health: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -284,23 +298,38 @@ class Router:
     # -- health probing ------------------------------------------------------
 
     def _refresh_endpoints(self) -> None:
-        eps = dict(self._endpoints())
-        for rid, (h, p) in eps.items():
+        eps = {}
+        for rid, val in dict(self._endpoints()).items():
+            # (host, port) = singleton group; (host, port, group) = shard
+            h, p, g = val if len(val) == 3 else (val[0], val[1], rid)
+            eps[rid] = (h, p, g)
+        for rid, (h, p, g) in eps.items():
             st = self.replicas.get(rid)
             if st is None:
                 self.replicas[rid] = ReplicaState(
-                    rid=rid, host=h, port=p, breaker=CircuitBreaker(**self._breaker_opts)
+                    rid=rid, host=h, port=p, group=g,
+                    breaker=CircuitBreaker(**self._breaker_opts),
                 )
             elif (st.host, st.port) != (h, p):
                 # restarted under a new ephemeral port: fresh start — the old
                 # failure streak belonged to the dead incarnation
                 logger.info(f"replica {rid}: endpoint now {h}:{p}")
-                st.host, st.port = h, p
+                st.host, st.port, st.group = h, p, g
                 st.healthy, st.status, st.health = False, "restarted", {}
                 st.breaker = CircuitBreaker(**self._breaker_opts)
+            else:
+                st.group = g
         for rid in list(self.replicas):
             if rid not in eps:
                 del self.replicas[rid]
+
+    def _groups(self) -> Dict[str, List[ReplicaState]]:
+        """Replicas keyed by shard group (a plain replica is its own
+        group).  One group = one servable model instance."""
+        groups: Dict[str, List[ReplicaState]] = {}
+        for st in self.replicas.values():
+            groups.setdefault(st.group or st.rid, []).append(st)
+        return groups
 
     async def _prober(self) -> None:
         while True:
@@ -310,6 +339,17 @@ class Router:
                 healthy = sum(st.healthy for st in self.replicas.values())
                 self.stats.set_gauge("healthy_replicas", healthy)
                 self.stats.set_gauge("known_replicas", len(self.replicas))
+                groups = self._groups()
+                self.stats.set_gauge(
+                    "healthy_groups",
+                    sum(all(st.healthy for st in m) for m in groups.values()),
+                )
+                self.stats.set_gauge("known_groups", len(groups))
+                for gid, members in groups.items():
+                    self.stats.set_gauge(
+                        f"group_{gid}_healthy",
+                        int(all(st.healthy for st in members)),
+                    )
                 for st in self.replicas.values():
                     self.stats.set_gauge(f"replica_{st.rid}_healthy", int(st.healthy))
                     self.stats.set_gauge(
@@ -358,19 +398,24 @@ class Router:
     # -- selection -----------------------------------------------------------
 
     def _pick(self, exclude: Set[str]) -> Optional[ReplicaState]:
-        candidates = [
-            st
-            for st in self.replicas.values()
-            if st.rid not in exclude and st.port is not None and st.healthy
-        ]
-        ready = [st for st in candidates if st.breaker.state == "closed"]
+        # a group is routable only when every shard is healthy; requests go
+        # to its primary (lowest rid), scored by the whole group's load
+        candidates: List[Tuple[ReplicaState, int]] = []
+        for members in self._groups().values():
+            if not all(st.healthy and st.port is not None for st in members):
+                continue
+            primary = min(members, key=lambda s: s.rid)
+            if primary.rid in exclude:
+                continue
+            candidates.append((primary, sum(st.load() for st in members)))
+        ready = [(st, load) for st, load in candidates if st.breaker.state == "closed"]
         if not ready:
             # no closed circuit: offer half-open trials (allow() mutates)
-            ready = [st for st in candidates if st.breaker.allow()]
+            ready = [(st, load) for st, load in candidates if st.breaker.allow()]
         if not ready:
             return None
-        best = min(st.load() for st in ready)
-        pool = sorted((st for st in ready if st.load() == best), key=lambda s: s.rid)
+        best = min(load for _, load in ready)
+        pool = sorted((st for st, load in ready if load == best), key=lambda s: s.rid)
         self._rr += 1
         return pool[self._rr % len(pool)]
 
@@ -430,6 +475,7 @@ class Router:
             replicas[st.rid] = {
                 "host": st.host,
                 "port": st.port,
+                "group": st.group or st.rid,
                 "healthy": st.healthy,
                 "status": st.status,
                 "circuit": st.breaker.state,
@@ -439,17 +485,32 @@ class Router:
             if st.healthy:
                 queue_depth += int(st.health.get("queue_depth", 0))
                 active_slots += int(st.health.get("active_slots", 0))
+        # one group = one servable (possibly tp/fsdp-sharded) model instance:
+        # the router is "ok" iff at least one WHOLE group answers, a stricter
+        # bar than any-replica-healthy when groups have > 1 shard
+        groups = {}
+        for gid, members in self._groups().items():
+            groups[gid] = {
+                "shards": len(members),
+                "healthy": all(st.healthy for st in members),
+                "members": sorted(st.rid for st in members),
+                "load": sum(st.load() for st in members),
+            }
         healthy = sum(st.healthy for st in self.replicas.values())
+        healthy_groups = sum(g["healthy"] for g in groups.values())
         payload = {
-            "status": "ok" if healthy else "unavailable",
+            "status": "ok" if healthy_groups else "unavailable",
             "healthy_replicas": healthy,
             "known_replicas": len(self.replicas),
+            "healthy_groups": healthy_groups,
+            "known_groups": len(groups),
             "queue_depth": queue_depth,
             "active_slots": active_slots,
             "uptime_s": round(time.monotonic() - self._t_start, 3),
             "replicas": replicas,
+            "groups": groups,
         }
-        await respond_json(writer, 200 if healthy else 503, payload)
+        await respond_json(writer, 200 if healthy_groups else 503, payload)
 
     async def _proxy_generate(
         self,

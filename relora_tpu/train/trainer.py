@@ -79,6 +79,13 @@ _DTYPES = {
 #: metric keys materialized as ints (counts), everything else as floats
 _INT_METRICS = frozenset({"skipped", "n_skipped"})
 
+#: per-direction ICI bandwidth per chip (v4/v5e-class link budget), used to
+#: cost the modeled collectives behind the mfu_gap "comms" share.  Like the
+#: roofline constants in ops/attention_dispatch, absolute accuracy matters
+#: less than the ratio against measured device time — the modeled seconds
+#: are clamped to the compute fence actually observed at the flush.
+ICI_BW_BYTES = float(os.environ.get("RELORA_TPU_ICI_BW", 9.0e10))
+
 
 def _pull_metric_records(metric_dicts):
     """Materialize a batch of per-step device metric dicts in ONE bulk
@@ -126,8 +133,12 @@ def build_model(model_cfg: ModelConfig, lora: Optional[LoraSpec], cfg: TrainingC
             )
         attention_impl = cfg.sp_impl
     elif cfg.flash_attention and _on_tpu():
+        # explicit forcing knob: bypass the dispatcher, always the pallas arm
         attention_impl = "pallas"
     else:
+        # per-shape roofline dispatch (ops/attention_dispatch.choose_training_arm):
+        # flash vs xla vs naive chosen from (B, S, heads, head_dim) with
+        # backward cost modeled, flash struck off-TPU automatically
         attention_impl = "auto"
     kwargs = dict(
         config=model_cfg,
@@ -262,6 +273,12 @@ class Trainer:
             f"equivalent={counts['equivalent_params']/1e6:.2f}M"
         )
         self.param_counts = counts
+        self._comms_per_update_s = self._modeled_comms_per_update_s()
+        if self._comms_per_update_s:
+            logger.info(
+                f"modeled comms: {self._comms_per_update_s * 1e3:.2f} ms/update "
+                f"over ICI (mfu_gap/comms share)"
+            )
 
         if cfg.warmed_up_model and not self.resume_dir:
             params = self._load_warm_start(params, cfg.warmed_up_model)
@@ -396,8 +413,14 @@ class Trainer:
         )
         if self.lora_spec is not None:
             spec = self.lora_spec
+            # out_shardings pins the merged tree to the same placement as the
+            # donated input: without it a tp/fsdp-sharded param tree could
+            # come back replicated after a merge-and-reinit, silently turning
+            # every later train step into a resharding collective
             self._merge_fn = jax.jit(
-                functools.partial(merge_and_reinit, spec=spec), donate_argnums=0
+                functools.partial(merge_and_reinit, spec=spec),
+                donate_argnums=0,
+                out_shardings=self.shardings,
             )
         self._reset_fn = jax.jit(
             functools.partial(
@@ -622,6 +645,33 @@ class Trainer:
         return flops
 
     # ------------------------------------------------------------------
+    def _modeled_comms_per_update_s(self) -> float:
+        """Analytic per-update collective seconds for the current mesh:
+        grad all-reduce over data×fsdp, fsdp param all-gather (fwd + bwd
+        re-gather), and tp activation all-reduces (2 fwd + 2 bwd per layer
+        per microbatch), each costed as a ring over ICI
+        (``2(n-1)/n × bytes / BW``).  Zero on a single-chip mesh.  This is
+        the model behind the ``mfu_gap/comms`` share: it decomposes the
+        measured compute fence, it does not add to it."""
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        n_batch = shape["data"] * shape["fsdp"]
+        n_f, n_t = shape["fsdp"], shape["tensor"]
+        act_bytes = jnp.dtype(_DTYPES[self.cfg.dtype]).itemsize
+        ring = lambda n, nbytes: 2.0 * (n - 1) / n * nbytes
+        total = 0.0
+        if n_batch > 1:
+            # grads sync once per update in f32, trainable params only
+            total += ring(n_batch, self.param_counts["trainable_params"] * 4)
+        if n_f > 1:
+            # params all-gather for fwd and again for the remat'd bwd
+            total += 2.0 * ring(n_f, self.param_counts["total_params"] * act_bytes)
+        if n_t > 1:
+            mc = self.model_cfg
+            act = self.cfg.batch_size * self.cfg.max_length * mc.hidden_size * act_bytes
+            total += 4.0 * mc.num_hidden_layers * self.grad_accum * ring(n_t, act)
+        return total / ICI_BW_BYTES
+
+    # ------------------------------------------------------------------
     def fit(
         self,
         train_iter: Iterator[np.ndarray],
@@ -686,8 +736,9 @@ class Trainer:
             Also emits the mfu_gap waterfall for the flushed window: the
             flush's single sync is split into a device-wait fence (the
             "compute" share) and the transfer, and the window's wall time is
-            partitioned into data_fetch / dispatch / compute / host shares
-            that sum to ~100% by construction (host is the residual:
+            partitioned into data_fetch / dispatch / compute / comms / host
+            shares that sum to ~100% by construction (comms is the modeled
+            collective time carved out of the fence; host is the residual:
             transfer, logging, python, and any eval/checkpoint cadence work
             that landed in the window)."""
             nonlocal spike, window_t0
@@ -706,16 +757,24 @@ class Trainer:
             disp_s = sum(b[-1][1] for b in batch)
             if wall > 0:
                 host_s = max(0.0, wall - data_s - disp_s - compute_s)
+                # comms-vs-compute split of the device fence: the modeled
+                # collective seconds (clamped so a wrong model can never
+                # claim more than the device time actually measured) come
+                # out of the compute share, so the five shares still sum to
+                # ~100% and a growing comms share reads as "the step is
+                # waiting on ICI, not on the MXU"
+                comms_s = min(compute_s, self._comms_per_update_s * len(batch))
                 gap = {
                     "mfu_gap/window_steps": len(batch),
                     "mfu_gap/wall_s": round(wall, 4),
                     "mfu_gap/data_fetch": round(min(1.0, data_s / wall), 4),
                     "mfu_gap/dispatch": round(min(1.0, disp_s / wall), 4),
-                    "mfu_gap/compute": round(min(1.0, compute_s / wall), 4),
+                    "mfu_gap/compute": round(min(1.0, (compute_s - comms_s) / wall), 4),
+                    "mfu_gap/comms": round(min(1.0, comms_s / wall), 4),
                     "mfu_gap/host": round(min(1.0, host_s / wall), 4),
                     "compile/steady_state_retraces": self.compile_watcher.steady_state_retraces,
                 }
-                for key in ("data_fetch", "dispatch", "compute", "host"):
+                for key in ("data_fetch", "dispatch", "compute", "comms", "host"):
                     self.obs.set_gauge(f"mfu_gap_{key}", gap[f"mfu_gap/{key}"])
                 # live HBM gauges at the same cadence (no-op on CPU; the
                 # poller must never run inside the per-step loop)

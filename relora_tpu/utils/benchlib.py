@@ -35,6 +35,7 @@ def run_throughput_bench(
     rank: Optional[int] = 128,
     quantize: Optional[str] = None,
     base_dtype: Optional[str] = None,
+    lora_fused="auto",
     dropout: float = 0.1,
     warmup_steps: int = 3,
     measure_steps: int = 10,
@@ -68,7 +69,14 @@ def run_throughput_bench(
 
     cfg = MODEL_ZOO[model_name]
     spec = (
-        LoraSpec(r=rank, alpha=32, dropout=dropout, quantize=quantize, base_dtype=base_dtype)
+        LoraSpec(
+            r=rank,
+            alpha=32,
+            dropout=dropout,
+            quantize=quantize,
+            base_dtype=base_dtype,
+            fused=lora_fused,
+        )
         if rank
         else None
     )

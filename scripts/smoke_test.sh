@@ -106,6 +106,12 @@ python main.py "${common[@]}" --lr 3e-3 --scheduler cosine --cycle_length 8 \
     --dtype float32 --num_training_steps 8 --save_every 100 \
     --save_dir "$WORK/full_fp32"
 
+echo "=== 6b. tp x fsdp composition parity (8 virtual devices, pytest -m parallel) ==="
+# the tentpole oracle: a tp=2 x fsdp=4 train step (and merge-and-reinit)
+# must match the single-device loss trace, and the kv-head-sharded page
+# pool must stay token-identical to the meshless paged engine
+python -m pytest tests/test_parallel_composition.py -q -m parallel -p no:cacheprovider
+
 echo "=== 7. analysis tools ==="
 python tools/analyze_rank.py --before "$WORK/relora/model_16" --after "$WORK/relora/model_40" | head -4
 python tools/inspect_optimizer.py "$WORK/relora/model_40" | head -3

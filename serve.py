@@ -97,6 +97,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--chunk-size", type=int, default=64, help="paged: prefill chunk length")
     p.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor-parallel group size: shard params (and the paged KV "
+        "pool over kv-heads, scaling --num-pages per chip) across this many "
+        "devices as ONE replica (docs/parallelism.md)",
+    )
+    p.add_argument(
         "--kv-dtype",
         choices=("bf16", "int8"),
         default="bf16",
@@ -137,9 +145,16 @@ def _decode_tokens(tokens, tokenizer) -> str:
 
 
 def main(argv=None) -> int:
-    from relora_tpu.utils.logging import get_logger, honor_platform_request
+    from relora_tpu.utils.logging import (
+        enable_xla_overlap_flags,
+        get_logger,
+        honor_platform_request,
+    )
 
     honor_platform_request()
+    # before the first jax import: a tensor-sharded serving engine overlaps
+    # its attention/mlp collectives the same way the train step does
+    enable_xla_overlap_flags()
     args = parse_args(argv)
     logger = get_logger("relora_tpu.serve")
 
@@ -226,6 +241,19 @@ def main(argv=None) -> int:
     elif args.kv_dtype != "bf16":
         p_err = "--kv-dtype int8 requires --paged (the contiguous cache is unquantized)"
         raise SystemExit(p_err)
+    mesh = None
+    if args.tp > 1:
+        from relora_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        if len(jax.devices()) < args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} needs {args.tp} devices, have {len(jax.devices())}"
+            )
+        mesh = make_mesh(
+            MeshSpec(data=1, fsdp=1, tensor=args.tp, sequence=1),
+            devices=jax.devices()[: args.tp],
+        )
+        logger.info(f"tensor-parallel serving over {args.tp} devices")
     engine = InferenceEngine(
         model_cfg,
         params,
@@ -233,6 +261,7 @@ def main(argv=None) -> int:
         dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
         scan_layers=not args.no_scan,
         lora=lora_spec,
+        mesh=mesh,
         **paged_kwargs,
     )
     key = jax.random.PRNGKey(args.seed)

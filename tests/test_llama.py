@@ -206,27 +206,20 @@ def test_grouped_equal_heads_call_matches_expansion():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-def test_auto_dispatch_respects_backend_and_env(monkeypatch):
-    """auto only upgrades to pallas on TPU (never on the CPU test backend),
-    and the threshold env parses defensively."""
+def test_auto_dispatch_respects_backend():
+    """auto resolves through the roofline dispatcher: on the CPU test
+    backend the flash arm is struck (fused_available=False), so auto must
+    match a non-pallas arm bit-for-bit — dispatch never changes numerics."""
     from relora_tpu.ops import attention as A
+    from relora_tpu.ops.attention_dispatch import choose_training_arm
 
     key = jax.random.PRNGKey(2)
     q = jax.random.normal(key, (1, 256, 2, 8))
-    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "128")
+    arm = choose_training_arm(1, 256, 2, 2, 8, act_bytes=4, fused_available=False)
+    assert arm in ("xla", "naive")
     out_auto = A.dot_product_attention(q, q, q, causal=True, impl="auto")
-    out_xla = A.dot_product_attention(q, q, q, causal=True, impl="xla")
-    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_xla), atol=0)
-
-    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "0")
-    assert A._pallas_min_seq() > 1 << 40  # disabled
-    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "banana")
-    assert A._pallas_min_seq() > 1 << 40  # unparseable -> disabled
-    monkeypatch.delenv("RELORA_TPU_PALLAS_MIN_SEQ")
-    # pallas dispatch is opt-in until the crossover is measured on-chip
-    assert A._pallas_min_seq() > 1 << 40
-    monkeypatch.setenv("RELORA_TPU_PALLAS_MIN_SEQ", "4096")
-    assert A._pallas_min_seq() == 4096
+    out_arm = A.dot_product_attention(q, q, q, causal=True, impl=arm)
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_arm), atol=0)
 
 
 @pytest.mark.slow

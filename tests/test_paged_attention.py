@@ -16,13 +16,17 @@ import numpy as np
 import pytest
 
 from relora_tpu.ops.attention import (
+    dot_product_attention,
     paged_cached_attention,
     paged_decode_attention,
 )
 from relora_tpu.ops.attention_dispatch import (
     ARMS,
+    TRAIN_ARMS,
     choose_arm,
+    choose_training_arm,
     estimate_arm_times,
+    estimate_training_arm_times,
     paged_attention,
 )
 from relora_tpu.ops.quant import quantize_kv_page
@@ -169,3 +173,79 @@ def test_dispatch_rejects_unknown_arm():
     q, pk, pv, bt, pos = _pool_case(8)
     with pytest.raises(ValueError, match="unknown/unservable"):
         paged_attention(q, pk, pv, bt, pos, arm="flash")
+
+
+# ---------------------------------------------------------------------------
+# training dispatch (choose_training_arm) — replaces the old
+# RELORA_TPU_PALLAS_MIN_SEQ threshold with a fwd+bwd roofline ranking
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_training_arm_times_ranking():
+    """At the flagship training shape (B=4, S=1024, 16 heads, d=64) the
+    fwd+bwd model must rank flash < xla < naive: flash skips masked causal
+    blocks and never materializes the S² score matrix; naive pays f32 score
+    traffic four ways."""
+    t = estimate_training_arm_times(4, 1024, 16, 16, 64, act_bytes=2)
+    assert set(t) == set(TRAIN_ARMS)
+    assert all(v > 0 for v in t.values())
+    assert t["flash"] < t["xla"] < t["naive"]
+    # the backward roughly triples every arm's cost, preserving the order
+    fwd = estimate_training_arm_times(4, 1024, 16, 16, 64, act_bytes=2, with_backward=False)
+    assert all(t[a] > fwd[a] for a in TRAIN_ARMS)
+    assert fwd["flash"] < fwd["xla"] < fwd["naive"]
+
+
+def test_choose_training_arm_regimes():
+    # flagship shape on TPU -> flash kernel
+    assert choose_training_arm(4, 1024, 16, 16, 64) == "flash"
+    # same shape off-TPU: flash struck, xla wins (never naive)
+    assert choose_training_arm(4, 1024, 16, 16, 64, fused_available=False) == "xla"
+    # non-128-tileable S strikes flash even with the kernel available
+    assert choose_training_arm(4, 96, 16, 16, 64) != "flash"
+    # allow= restricts the candidate set
+    assert choose_training_arm(4, 1024, 16, 16, 64, allow=("naive",)) == "naive"
+    # empty candidate set degrades to the safe default
+    assert (
+        choose_training_arm(4, 1024, 16, 16, 64, fused_available=False, allow=("flash",))
+        == "xla"
+    )
+
+
+def _train_qkv(seed, *, B=2, S=64, heads=4, kv_heads=2, head_dim=8):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, heads, head_dim), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, kv_heads, head_dim), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, kv_heads, head_dim), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas", "auto"])
+def test_training_forced_arm_parity(impl):
+    """Every CPU-runnable training arm matches the naive f32 oracle in value
+    AND gradient — dispatch changes the compute graph, never the result.
+    (``pallas`` at this sub-tile S exercises the kernel's fused-XLA fallback;
+    the on-TPU kernel itself is held to the same oracle by the bench's
+    attention mode.)"""
+    q, k, v = _train_qkv(0)
+    want = dot_product_attention(q, k, v, impl="naive")
+    got = dot_product_attention(q, k, v, impl=impl)
+    assert got.shape == want.shape
+    assert _max_err(got, want) < 1e-5, f"impl={impl}"
+
+    def loss(fn_impl):
+        return lambda qq: jnp.sum(dot_product_attention(qq, k, v, impl=fn_impl) ** 2)
+
+    g_want = jax.grad(loss("naive"))(q)
+    g_got = jax.grad(loss(impl))(q)
+    assert _max_err(g_got, g_want) < 1e-4, f"impl={impl} (backward)"
+
+
+def test_training_auto_on_cpu_is_xla_bitwise():
+    """Off-TPU the dispatcher must resolve auto to the xla arm (flash is
+    struck, and the model ranks xla under naive) — bitwise, no interpreter."""
+    assert jax.default_backend() != "tpu"
+    q, k, v = _train_qkv(1)
+    auto = dot_product_attention(q, k, v, impl="auto")
+    forced = dot_product_attention(q, k, v, impl="xla")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(forced))

@@ -1,0 +1,232 @@
+"""TP × FSDP composition over the 8 virtual CPU devices (conftest).
+
+The tentpole invariants of the parallelism layer, held on CPU where they are
+cheap to check bit-for-bit:
+
+- a tp=2 × fsdp=4 train step — tensor collectives INSIDE the layer, fsdp
+  param gathers AROUND it — produces the same losses as the identical
+  program on one device, through a merge-and-reinit and beyond (dispatch
+  and sharding change the compute graph, never the result);
+- merge-and-reinit keeps the merged tree on its training shardings (the
+  trainer pins ``out_shardings`` for exactly this — a replicated comeback
+  after every cycle would OOM at real dims);
+- the paged serving engine with the pool sharded over kv-heads
+  (``kv_shards > 1``, page budget scaled per shard) stays token-identical
+  to the meshless paged engine and to the contiguous scheduler.
+
+The compile-heavy tests are marked ``slow`` (tier-1 runs cold-compiled under
+a wall-clock budget); the smoke-test ``parallel`` stage runs all of them via
+``-m parallel``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.optim import build_optimizer, init_opt_state_sharded
+from relora_tpu.core.partition import partition
+from relora_tpu.core.relora import LoraSpec, merge_and_reinit, trainable_param_mask
+from relora_tpu.core.schedules import make_schedule
+from relora_tpu.models.llama import LlamaForCausalLM
+from relora_tpu.models.params_util import init_params, logical_partition_specs
+from relora_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    set_current_mesh,
+    shard_params,
+)
+from relora_tpu.train.state import TrainState
+from relora_tpu.train.step import make_train_step
+
+pytestmark = pytest.mark.parallel
+
+# kv_heads=2 splits exactly over tensor=2: the ("kv", tensor) logical rule
+# and the serving pool's kv-head sharding both activate
+CFG = ModelConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_sequence_length=64,
+)
+GA, MICRO, SEQ = 2, 4, 16
+
+
+def _batches(n_steps):
+    rs = np.random.RandomState(0)
+    return [
+        jnp.asarray(rs.randint(0, CFG.vocab_size, (GA, MICRO, SEQ)), jnp.int32)
+        for _ in range(n_steps)
+    ]
+
+
+_SINGLE = {}
+
+
+def _single_device_reference():
+    """The meshless oracle trace, shared across parity tests (it is identical
+    for every composed mesh under test, and compiling it twice is the single
+    most expensive redundancy in this file)."""
+    if "ref" not in _SINGLE:
+        _SINGLE["ref"] = _run_training(
+            jax.devices()[:1], MeshSpec(data=1, fsdp=1, tensor=1, sequence=1)
+        )
+    return _SINGLE["ref"]
+
+
+def _run_training(devices, mesh_spec, n_steps=2):
+    """Train ``n_steps``, merge-and-reinit, train one more step; return the
+    loss trace plus whether any merged leaf stayed non-replicated."""
+    mesh = make_mesh(mesh_spec, devices=devices)
+    set_current_mesh(mesh)
+    try:
+        spec = LoraSpec(r=8, alpha=32, dropout=0.0)
+        model = LlamaForCausalLM(CFG, lora=spec, dtype=jnp.float32, scan_layers=True)
+        sample = jnp.zeros((2, 8), jnp.int32)
+        params = init_params(model, jax.random.PRNGKey(0), sample)
+        mask = trainable_param_mask(params)
+        schedule = make_schedule(
+            "cosine_restarts",
+            lr=1e-3,
+            num_training_steps=100,
+            warmup_steps=10,
+            cycle_length=50,
+            restart_warmup_steps=5,
+        )
+        tx = build_optimizer(schedule=schedule)
+        shardings = param_shardings(mesh, logical_partition_specs(model, sample))
+        params = shard_params(params, shardings)
+        with mesh:
+            opt_state = init_opt_state_sharded(tx, partition(params, mask)[0], mesh)
+        state = TrainState.create(params, opt_state)
+        step = jax.jit(make_train_step(model, tx, mask, schedule=schedule), donate_argnums=0)
+
+        losses = []
+        for batch in _batches(n_steps):
+            placed = jax.device_put(batch, batch_sharding(mesh))
+            state, metrics = step(state, placed, jax.random.PRNGKey(100))
+            losses.append(float(metrics["loss"]))
+
+        # merge-and-reinit pinned to the training shardings, as the trainer
+        # does (out_shardings in Trainer._merge_fn)
+        merged = jax.jit(
+            lambda p, k: merge_and_reinit(p, k, spec), out_shardings=shardings
+        )(state.params, jax.random.PRNGKey(3))
+        any_sharded = any(
+            not leaf.sharding.is_fully_replicated for leaf in jax.tree.leaves(merged)
+        )
+        state = state.replace(params=merged)
+        placed = jax.device_put(_batches(n_steps + 1)[-1], batch_sharding(mesh))
+        state, metrics = step(state, placed, jax.random.PRNGKey(101))
+        losses.append(float(metrics["loss"]))
+        return {"losses": losses, "any_sharded": any_sharded}
+    finally:
+        set_current_mesh(None)
+
+
+@pytest.mark.slow
+def test_tp_fsdp_train_step_matches_single_device():
+    """The acceptance oracle for the composed mesh: tp=2 × fsdp=4 loss trace
+    (including the post-merge step) matches the single-device run to f32
+    collective-reduction tolerance, and the merged tree is still sharded.
+
+    The train-compile-heavy tests in this file are ``slow`` — the suite
+    compiles everything cold (persistent cache off, see conftest) and tier-1
+    runs under a hard wall-clock budget; the smoke stage runs the whole file
+    via ``-m parallel``, which selects slow tests too."""
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provide 8 virtual CPU devices"
+    composed = _run_training(
+        devices[:8], MeshSpec(data=1, fsdp=4, tensor=2, sequence=1)
+    )
+    single = _single_device_reference()
+    np.testing.assert_allclose(
+        composed["losses"], single["losses"], rtol=5e-4, atol=1e-5
+    )
+    assert composed["any_sharded"], (
+        "merge-and-reinit returned a fully replicated tree on the tp x fsdp "
+        "mesh — out_shardings must pin the merged params to their training "
+        "shardings"
+    )
+    # the losses actually moved (the trace is not a frozen constant)
+    assert composed["losses"][0] != composed["losses"][-1]
+
+
+@pytest.mark.slow
+def test_data_x_tensor_mesh_also_matches():
+    """Same oracle with the batch axes split as data=2 × fsdp=2 and tensor=2:
+    grad all-reduce, fsdp gathers, and tensor collectives all live in one
+    step."""
+    devices = jax.devices()
+    composed = _run_training(
+        devices[:8], MeshSpec(data=2, fsdp=2, tensor=2, sequence=1)
+    )
+    single = _single_device_reference()
+    np.testing.assert_allclose(
+        composed["losses"], single["losses"], rtol=5e-4, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# serving: page pool sharded over kv-heads
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_paged_engine_pool_sharded_over_kv_heads():
+    """A tensor=2 mesh shards the page pool's kv_heads axis and doubles the
+    page budget (num_pages is per-chip); the sharded engine must stay
+    token-identical to the meshless paged engine for the same requests."""
+    from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+    from relora_tpu.serve.scheduler import PagedContinuousBatchingScheduler, Request
+
+    devices = jax.devices()
+    mesh = make_mesh(MeshSpec(data=1, fsdp=1, tensor=2, sequence=1), devices[:2])
+
+    model = build_decode_model(CFG, cache_size=32)
+    base = type(model)(CFG, lora=None, dtype=jnp.float32, scan_layers=True)
+    params = init_params(base, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    kwargs = dict(cache_size=32, page_size=8, num_pages=13, chunk_size=8)
+    plain = InferenceEngine(CFG, params, **kwargs)
+    sharded = InferenceEngine(CFG, params, mesh=mesh, **kwargs)
+
+    assert sharded.kv_shards == 2
+    assert sharded.num_pages == 2 * plain.num_pages  # per-chip budget scaled
+    pool = sharded.init_pool()
+    assert any(
+        not leaf.sharding.is_fully_replicated for leaf in jax.tree.leaves(pool)
+    ), "page pool came back replicated despite the tensor axis"
+
+    reqs = lambda: [
+        Request(uid=1, prompt=list(range(1, 14)), max_new_tokens=5),
+        Request(uid=2, prompt=[7, 8, 9], max_new_tokens=5),
+    ]
+    want = PagedContinuousBatchingScheduler(plain, max_batch=2).run(reqs())
+    got = PagedContinuousBatchingScheduler(sharded, max_batch=2).run(reqs())
+    assert {u: c.tokens for u, c in got.items()} == {
+        u: c.tokens for u, c in want.items()
+    }
+
+
+def test_pool_sharding_skipped_when_kv_heads_indivisible():
+    """kv_heads=2 does not divide tensor=4: the engine must fall back to a
+    replicated pool (kv_shards=1) rather than produce an invalid sharding."""
+    from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+
+    devices = jax.devices()
+    mesh = make_mesh(MeshSpec(data=1, fsdp=1, tensor=4, sequence=1), devices[:4])
+    base = type(build_decode_model(CFG, cache_size=32))(
+        CFG, lora=None, dtype=jnp.float32, scan_layers=True
+    )
+    params = init_params(base, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    eng = InferenceEngine(
+        CFG, params, mesh=mesh, cache_size=32, page_size=8, num_pages=13
+    )
+    assert eng.kv_shards == 1
+    assert eng.num_pages == 13

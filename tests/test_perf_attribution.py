@@ -363,6 +363,10 @@ def test_bench_gate_passes_on_committed_files():
 
 def test_bench_gate_fails_on_synthetic_regression(tmp_path):
     base = json.loads((REPO / "BENCH_r05.json").read_text())
+    # the committed r05 is an outage replay (detail.stale); the gate rightly
+    # ignores those, so build the synthetic trajectory from fresh rounds
+    base["parsed"] = dict(base["parsed"], detail=dict(base["parsed"]["detail"]))
+    base["parsed"]["detail"].pop("stale", None)
     (tmp_path / "BENCH_r05.json").write_text(json.dumps(base))
     worse = dict(base, n=6)
     worse["parsed"] = dict(base["parsed"], value=round(base["parsed"]["value"] * 0.8, 1))

@@ -1,23 +1,28 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Four rules, each skipped gracefully when its input files are absent:
+Six rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
-   ignored) must be within ``--tolerance`` (default 10%) of the best
-   previous real round.  A fresh regression shows up as the newest value
-   dropping below ``best * (1 - tolerance)``.
-2. **serving latency** (``BENCH_http.json`` vs ``tools/bench_baselines.json``):
+   ignored, as are stale replays with ``detail.stale``) must be within
+   ``--tolerance`` (default 10%) of the best previous real round.  A
+   fresh regression shows up as the newest value dropping below
+   ``best * (1 - tolerance)``.
+2. **MFU floor** (``BENCH_r*.json``): the newest non-stale on-TPU round
+   must report ``detail.mfu >= --mfu-floor`` (default 0.25, or the
+   ``mfu_floor`` key in the baselines file).  Skipped for stale replays
+   and CPU rounds — off-TPU numbers say nothing about chip utilization.
+3. **serving latency** (``BENCH_http.json`` vs ``tools/bench_baselines.json``):
    per-level ``ttft_p95_ms`` / ``tpot_p95_ms`` must stay under the committed
    caps (baseline p95 x (1 + tolerance), pre-expanded in the baselines file
    with generous CPU-noise margins).
-3. **router failover** (``BENCH_http.json`` ``detail.router``): zero hung
+4. **router failover** (``BENCH_http.json`` ``detail.router``): zero hung
    requests under a mid-run replica SIGKILL, the killed replica restarted,
    and clean/kill ``ttft_p95_ms`` under the committed router caps.
-4. **obs overhead** (``BENCH_obs.json``): ``detail.within_budget`` must be
+5. **obs overhead** (``BENCH_obs.json``): ``detail.within_budget`` must be
    true — the span tracer's measured overhead stayed inside its budget_pct.
-5. **attention kernel** (``BENCH_attn.json``): on TPU the fused paged-decode
+6. **attention kernel** (``BENCH_attn.json``): on TPU the fused paged-decode
    arm must not lose to the naive gather arm by more than ``--tolerance``
    on any decode bucket, and the roofline's ``model_choice`` must agree
    with ``measured_best`` on the arm family.  Skipped entirely when the
@@ -58,13 +63,19 @@ def _load(path: str) -> Optional[Dict[str, Any]]:
 
 def real_rounds(bench_dir: str) -> List[Tuple[int, float]]:
     """(round_n, tok/s) for every round with a real measurement, sorted by n.
-    Watchdog/stalled rounds (value <= 0) carry no signal and are dropped."""
+    Watchdog/stalled rounds (value <= 0) carry no signal and are dropped, as
+    are stale replays (``detail.stale`` — an outage round re-emitting the
+    last on-chip number is provenance, not a fresh measurement: comparing it
+    against itself would mask a real regression on the next live round)."""
     rounds = []
     for path in glob.glob(os.path.join(bench_dir, "BENCH_r[0-9]*.json")):
         doc = _load(path)
         if not doc:
             continue
-        value = (doc.get("parsed") or {}).get("value")
+        parsed = doc.get("parsed") or {}
+        if (parsed.get("detail") or {}).get("stale"):
+            continue
+        value = parsed.get("value")
         if isinstance(value, (int, float)) and value > 0:
             rounds.append((int(doc.get("n", 0)), float(value)))
     rounds.sort()
@@ -83,6 +94,38 @@ def check_train(bench_dir: str, tolerance: float) -> List[str]:
             f"train tok/s: round {latest_n} = {latest:,.1f} is "
             f"{(1 - latest / best) * 100:.1f}% below best round {best_n} "
             f"({best:,.1f}); floor at {tolerance * 100:.0f}% is {floor:,.1f}"
+        ]
+    return []
+
+
+def check_mfu(bench_dir: str, floor: float) -> List[str]:
+    """MFU floor over the train rounds: the newest non-stale on-TPU round
+    reporting ``detail.mfu`` must meet ``floor``.  Stale replays and CPU
+    rounds are skipped — a tunnel outage or an off-TPU CI run says nothing
+    about chip utilization.  The floor is a ratchet guard under the 50%
+    north star: it holds the measured band, it is not the target itself."""
+    latest: Optional[Tuple[int, float]] = None
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r[0-9]*.json")):
+        doc = _load(path)
+        if not doc:
+            continue
+        parsed = doc.get("parsed") or {}
+        detail = parsed.get("detail") or {}
+        mfu = detail.get("mfu")
+        if detail.get("stale") or not isinstance(mfu, (int, float)) or mfu <= 0:
+            continue
+        if "cpu" in str(detail.get("device", "")).lower():
+            continue
+        n = int(doc.get("n", 0))
+        if latest is None or n > latest[0]:
+            latest = (n, float(mfu))
+    if latest is None:
+        return []
+    n, mfu = latest
+    if mfu < floor:
+        return [
+            f"mfu: round {n} measured {mfu * 100:.1f}% MFU, below the "
+            f"{floor * 100:.0f}% floor (north star is >= 50%)"
         ]
     return []
 
@@ -207,6 +250,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="serving-latency caps JSON ('' disables the http rule)",
     )
     ap.add_argument(
+        "--mfu-floor",
+        type=float,
+        default=None,
+        help="minimum MFU for the newest non-stale on-TPU round "
+        "(default: baselines 'mfu_floor', else 0.25; 0 disables)",
+    )
+    ap.add_argument(
         "--warn-only",
         action="store_true",
         help="report regressions but exit 0 (off-TPU CI, where numbers are noisy)",
@@ -217,8 +267,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     baselines = _load(args.baselines) if args.baselines else None
+    mfu_floor = args.mfu_floor
+    if mfu_floor is None:
+        mfu_floor = float((baselines or {}).get("mfu_floor", 0.25))
     failures = (
         check_train(args.dir, args.tolerance)
+        + (check_mfu(args.dir, mfu_floor) if mfu_floor > 0 else [])
         + check_http(args.dir, baselines)
         + check_router(args.dir, baselines)
         + check_obs(args.dir)

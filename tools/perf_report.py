@@ -83,7 +83,7 @@ def print_waterfall(records: List[Dict[str, Any]], out) -> bool:
         return False
     shares = {
         key: mean([g.get(f"mfu_gap/{key}", 0.0) for g in gaps])
-        for key in ("data_fetch", "dispatch", "compute", "host")
+        for key in ("data_fetch", "dispatch", "compute", "comms", "host")
     }
     total_wall = sum(g["mfu_gap/wall_s"] for g in gaps)
     n_steps = sum(int(g.get("mfu_gap/window_steps", 0)) for g in gaps)
