@@ -1076,6 +1076,129 @@ def attention_main() -> None:
     print(json.dumps(result))
 
 
+def _collector_overhead_ab() -> dict:
+    """Fleet-collector scrape cost on a live replica: closed-loop tok/s A/B.
+
+    Boots one in-process GenerateServer over a tiny random-init model, then
+    drives closed-loop generation with the FleetCollector alternately off
+    and on (scraping ``/metrics`` + ``/healthz`` at a sub-second cadence —
+    far hotter than the supervisor's 1s default, so the measurement bounds
+    production).  Arms are interleaved and best-of so both see the same
+    thermal/scheduler conditions; overhead is the on-arm throughput loss,
+    clipped at zero (scrapes ride the idle event loop, so small negative
+    deltas are pure noise)."""
+    import asyncio
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from relora_tpu.config.model import load_model_config
+    from relora_tpu.models.params_util import init_params
+    from relora_tpu.obs.fleet import FleetCollector
+    from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+    from relora_tpu.serve.scheduler import ContinuousBatchingScheduler
+    from relora_tpu.serve.server import GenerateServer
+
+    model_name = os.environ.get("BENCH_OBS_SERVE_MODEL", "llama_9m")
+    duration = float(os.environ.get("BENCH_OBS_SERVE_DURATION", "2.0"))
+    cadence = float(os.environ.get("BENCH_OBS_CADENCE_S", "0.25"))
+    ab_repeats = int(os.environ.get("BENCH_OBS_AB_REPEATS", "3"))
+    prompt_len, new_tokens, workers = 8, 16, 4
+
+    cfg = load_model_config(model_name)
+    cache_size = 1 << (prompt_len + new_tokens + 8 - 1).bit_length()
+    model = build_decode_model(cfg, cache_size=cache_size)
+    params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    engine = InferenceEngine(cfg, params, cache_size=cache_size)
+    engine.warmup(workers, prompt_buckets=(prompt_len,))
+    scheduler = ContinuousBatchingScheduler(engine, max_batch=workers)
+    server = GenerateServer(scheduler, port=0, max_queue=2 * workers)
+
+    async def one_request(i: int) -> int:
+        body = json.dumps(
+            {"prompt": [(i * 7 + j) % cfg.vocab_size for j in range(prompt_len)],
+             "max_new_tokens": new_tokens, "stream": False}
+        ).encode()
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        writer.write(
+            (
+                "POST /v1/generate HTTP/1.1\r\nHost: bench\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            ).encode()
+            + body
+        )
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        while (await reader.readline()).strip():
+            pass
+        payload = await reader.read()
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+        if status != 200:
+            return 0
+        return len(json.loads(payload).get("tokens", []))
+
+    async def closed_loop_tok_s() -> float:
+        tokens = 0
+        t0 = time.perf_counter()
+        stop = t0 + duration
+
+        async def worker(w: int) -> None:
+            nonlocal tokens
+            i = w
+            while time.perf_counter() < stop:
+                tokens += await one_request(i)
+                i += workers
+
+        await asyncio.gather(*(worker(w) for w in range(workers)))
+        return tokens / (time.perf_counter() - t0)
+
+    async def bench() -> dict:
+        serve_task = asyncio.ensure_future(
+            server.serve_forever(install_signal_handlers=False)
+        )
+        while not server.started.is_set():
+            await asyncio.sleep(0.01)
+            if serve_task.done():
+                serve_task.result()
+        await closed_loop_tok_s()  # warm both arms' code paths
+        off_runs, on_runs, scrapes = [], [], 0
+        for _ in range(ab_repeats):
+            off_runs.append(await closed_loop_tok_s())
+            coll = FleetCollector(
+                lambda: {"r0": ("127.0.0.1", server.port)},
+                cadence_s=cadence, timeout_s=0.5,
+            )
+            coll.start()
+            try:
+                on_runs.append(await closed_loop_tok_s())
+            finally:
+                coll.stop()
+            scrapes += len(coll.store.samples("r0", "up"))
+        server.begin_drain()
+        await serve_task
+        off_tok_s, on_tok_s = max(off_runs), max(on_runs)
+        overhead_pct = max(0.0, 100.0 * (off_tok_s - on_tok_s) / off_tok_s)
+        return {
+            "off_tok_s": round(off_tok_s, 2),
+            "on_tok_s": round(on_tok_s, 2),
+            "overhead_pct": round(overhead_pct, 3),
+            "cadence_s": cadence,
+            "scrapes": scrapes,
+            "duration_s": duration,
+            "repeats": ab_repeats,
+            "budget_pct": 1.0,
+            "within_budget": overhead_pct < 1.0,
+        }
+
+    return asyncio.run(bench())
+
+
 def obs_overhead_main() -> None:
     """--mode obs_overhead: tracer cost on the train hot path.
 
@@ -1150,6 +1273,8 @@ def obs_overhead_main() -> None:
             pass
     span_us = (time.perf_counter() - t0) / n_probe * 1e6
 
+    collector = _collector_overhead_ab()
+
     result = {
         "metric": f"span tracer overhead on {model_name} train step "
         f"(3 spans/step, best of {repeats}x{steps})",
@@ -1167,6 +1292,7 @@ def obs_overhead_main() -> None:
             "analytic_overhead_pct": round(100.0 * 3 * span_us / (noop_s * 1e6), 4),
             "budget_pct": 1.0,
             "within_budget": overhead_pct < 1.0,
+            "collector": collector,
         },
     }
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_obs.json")

@@ -8,6 +8,13 @@ trainer, and signal handlers.  See docs/observability.md.
 """
 
 from relora_tpu.obs.compile import CompileEvent, CompileWatcher, abstract_signature, signature_diff
+from relora_tpu.obs.fleet import (
+    FleetCollector,
+    SeriesStore,
+    histogram_quantile,
+    load_series_jsonl,
+    parse_prometheus,
+)
 from relora_tpu.obs.flight import FlightRecorder, configure, default_recorder, dump_on_fault
 from relora_tpu.obs.memory import (
     MemoryPoller,
@@ -21,6 +28,15 @@ from relora_tpu.obs.memory import (
 )
 from relora_tpu.obs.metrics import LATENCY_BUCKETS, Histogram, MetricsRegistry
 from relora_tpu.obs.mfu import peak_flops, step_flops_from_cost_analysis
+from relora_tpu.obs.slo import (
+    SLO,
+    Alert,
+    AnomalySpec,
+    SeriesAnomalyDetector,
+    SLOEngine,
+    default_slos,
+    load_slo_config,
+)
 from relora_tpu.obs.tracer import (
     NoopTracer,
     Span,
@@ -44,6 +60,18 @@ __all__ = [
     "pytree_bytes",
     "reconcile",
     "xla_memory_plan",
+    "FleetCollector",
+    "SeriesStore",
+    "histogram_quantile",
+    "load_series_jsonl",
+    "parse_prometheus",
+    "SLO",
+    "Alert",
+    "AnomalySpec",
+    "SeriesAnomalyDetector",
+    "SLOEngine",
+    "default_slos",
+    "load_slo_config",
     "FlightRecorder",
     "configure",
     "default_recorder",

@@ -114,6 +114,9 @@ class Span:
             "parent_id": self.parent_id,
             "t_start": self.t_start,
             "t_end": self.t_end,
+            # wall-clock start: lets trace_report join spans from different
+            # processes (each with its own monotonic origin) on one timeline
+            "t_wall": self._tracer.wall_anchor + self.t_start,
             "dur_s": None if self.t_end is None else self.t_end - self.t_start,
             "thread": self.thread,
             "service": self._tracer.service,
@@ -244,17 +247,23 @@ class Tracer:
         top = self.current_span()
         if trace_id is None:
             trace_id = top.trace_id if top is not None else self.default_trace_id
-        self.recorder.add_event(
-            {
-                "name": name,
-                "trace_id": trace_id,
-                "parent_id": top.span_id if top is not None else None,
-                "t": self.clock(),
-                "thread": threading.current_thread().name,
-                "service": self.service,
-                "attrs": attrs,
-            }
-        )
+        t = self.clock()
+        record = {
+            "name": name,
+            "trace_id": trace_id,
+            "parent_id": top.span_id if top is not None else None,
+            "t": t,
+            "t_wall": self.wall_anchor + t,
+            "thread": threading.current_thread().name,
+            "service": self.service,
+            "attrs": attrs,
+        }
+        self.recorder.add_event(record)
+        fh = self._jsonl_fh
+        if fh is not None:
+            with self._jsonl_lock:
+                fh.write(json.dumps({"_event": True, **record}) + "\n")
+                fh.flush()
 
     def close(self) -> None:
         fh, self._jsonl_fh = self._jsonl_fh, None

@@ -58,12 +58,13 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Set, Tuple, Union
 
 from relora_tpu.obs.metrics import MetricsRegistry
-from relora_tpu.obs.tracer import new_trace_id
+from relora_tpu.obs.tracer import Tracer, new_trace_id
 from relora_tpu.serve.wire import (
     MAX_BODY_BYTES,
     REASONS,
@@ -231,6 +232,8 @@ class Router:
         failure_threshold: int = 3,
         cooldown_s: float = 1.0,
         cooldown_max_s: float = 30.0,
+        tracer: Optional[Tracer] = None,
+        extra_routes: Optional[Callable[[str], Optional[Tuple[int, str, bytes]]]] = None,
     ):
         self._endpoints = self._normalize_endpoints(endpoints)
         self.host = host
@@ -247,6 +250,23 @@ class Router:
             cooldown_max_s=cooldown_max_s,
         )
         self.stats = MetricsRegistry(namespace="relora_router")
+        if tracer is None:
+            # the proxy path spans join the replica's spans under the request
+            # id; a JSONL sink (one file per process, like the replicas') lets
+            # tools/trace_report.py merge router + replica streams offline
+            trace_dir = os.environ.get("RELORA_TPU_TRACE_DIR")
+            tracer = Tracer(
+                service="router",
+                jsonl_path=(
+                    os.path.join(trace_dir, f"router_spans_{os.getpid()}.jsonl")
+                    if trace_dir
+                    else None
+                ),
+            )
+        self.tracer = tracer
+        # e.g. the supervisor's FleetCollector mounting /fleet/* on this
+        # front-end: path -> (status, content_type, body) or None = 404
+        self._extra_routes = extra_routes
         self.replicas: Dict[str, ReplicaState] = {}
         self.started = threading.Event()
         self._t_start = time.monotonic()
@@ -332,10 +352,33 @@ class Router:
         return groups
 
     async def _prober(self) -> None:
+        # one span per probe *round* (not per replica probe: at 4 Hz x N
+        # replicas that would drown the flight ring); per-replica health and
+        # breaker *transitions* are instant events on the same trace
+        prev_state: Dict[str, Tuple[bool, str]] = {}
         while True:
             try:
                 self._refresh_endpoints()
+                round_span = self.tracer.start_span("probe_round", trace_id="probes")
                 await asyncio.gather(*(self._probe(st) for st in self.replicas.values()))
+                for st in self.replicas.values():
+                    prev = prev_state.get(st.rid)
+                    cur = (st.healthy, st.breaker.state)
+                    if prev is not None and prev != cur:
+                        if prev[0] != st.healthy:
+                            self.tracer.event(
+                                "replica_health_flip", trace_id="probes",
+                                replica=st.rid, healthy=st.healthy, status=st.status,
+                            )
+                        if prev[1] != st.breaker.state:
+                            self.tracer.event(
+                                "circuit_transition", trace_id="probes",
+                                replica=st.rid, frm=prev[1], to=st.breaker.state,
+                            )
+                    prev_state[st.rid] = cur
+                for rid in list(prev_state):
+                    if rid not in self.replicas:
+                        del prev_state[rid]
                 healthy = sum(st.healthy for st in self.replicas.values())
                 self.stats.set_gauge("healthy_replicas", healthy)
                 self.stats.set_gauge("known_replicas", len(self.replicas))
@@ -357,6 +400,9 @@ class Router:
                         int(st.breaker.state != "closed"),
                     )
                     self.stats.set_gauge(f"replica_{st.rid}_load", st.load())
+                round_span.set(
+                    healthy=healthy, known=len(self.replicas)
+                ).end()
             except Exception as e:  # the prober must never die
                 logger.warning(f"health probe round failed: {e!r}")
             await asyncio.sleep(self.probe_interval_s)
@@ -465,6 +511,13 @@ class Router:
                 return
             self.stats.inc("requests_total")
             await self._proxy_generate(writer, body, headers)
+        elif (
+            method == "GET"
+            and self._extra_routes is not None
+            and (mounted := self._extra_routes(path)) is not None
+        ):
+            status, ctype, payload = mounted
+            await respond(writer, status, payload.decode(), content_type=ctype)
         else:
             await respond_json(writer, 404, {"error": f"no route {route}"})
 
@@ -519,6 +572,23 @@ class Router:
         headers: Dict[str, str],
     ) -> None:
         rid_hdr = (headers.get("x-request-id") or "").strip() or new_trace_id()
+        # root span of this process's share of the request: trace_id is the
+        # request id, the same id the replica uses for its own spans, so the
+        # merged trace (tools/trace_report.py) shows router -> replica ->
+        # model thread as one tree
+        root = self.tracer.start_span("route", trace_id=rid_hdr)
+        try:
+            outcome = await self._proxy_attempts(writer, body, rid_hdr, root)
+        finally:
+            root.set(outcome=outcome if isinstance(outcome, str) else "error").end()
+
+    async def _proxy_attempts(
+        self,
+        writer: asyncio.StreamWriter,
+        body: bytes,
+        rid_hdr: str,
+        root,
+    ) -> str:
         # shared across attempts: once any SSE body byte reaches the client,
         # the request is no longer retryable (the idempotency boundary)
         sent = {"head": False, "bytes": 0}
@@ -533,21 +603,33 @@ class Router:
             if attempt > 0:
                 self.stats.inc("retries_total")
             st.inflight += 1
+            attempt_span = self.tracer.start_span(
+                "proxy_attempt", trace_id=rid_hdr, parent=root,
+                replica=st.rid, attempt=attempt,
+            )
+            outcome, info = "error", None
             try:
                 outcome, info = await self._forward(st, writer, body, rid_hdr, sent)
             finally:
                 st.inflight -= 1
+                attempt_span.set(outcome=outcome).end()
             if outcome == "done":
                 if attempt > 0:
                     self.stats.inc("failovers_total", ("replica", st.rid))
+                    self.tracer.event(
+                        "failover", trace_id=rid_hdr, replica=st.rid, attempt=attempt
+                    )
                 self.stats.inc("proxied_total", ("replica", st.rid))
-                return
+                return "done"
             if outcome == "client_gone":
                 self.stats.inc("client_disconnects_total")
-                return
+                return "client_gone"
             if outcome == "midstream":
                 # started stream died: typed terminal event, never a replay
                 self.stats.inc("midstream_errors_total", ("replica", st.rid))
+                self.tracer.event(
+                    "midstream_error", trace_id=rid_hdr, replica=st.rid, detail=str(info)
+                )
                 logger.warning(f"stream via {st.rid} interrupted: {info}")
                 event = {
                     "error": {
@@ -562,7 +644,7 @@ class Router:
                     await writer.drain()
                 except (ConnectionError, OSError):
                     pass
-                return
+                return "midstream"
             # outcome == "retry": zero body bytes forwarded; try a sibling
             self.stats.inc("upstream_failures_total", ("replica", st.rid))
             if isinstance(info, tuple):
@@ -588,7 +670,7 @@ class Router:
                 await writer.drain()
             except (ConnectionError, OSError):
                 pass
-            return
+            return "exhausted"
         if passthrough is not None:
             # deliver the last real upstream answer (e.g. 429 + Retry-After)
             status, up_headers, up_body = passthrough
@@ -599,13 +681,14 @@ class Router:
             writer.write(head(status, REASONS.get(status, "?"), ct, extra, len(up_body)))
             writer.write(up_body)
             await writer.drain()
-            return
+            return "passthrough"
         await respond_json(
             writer,
             503,
             {"error": "no healthy replica available"},
             extra_headers={"Retry-After": "1", "X-Request-Id": rid_hdr},
         )
+        return "no_replica"
 
     async def _forward(
         self,

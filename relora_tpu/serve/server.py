@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import signal
 import threading
 import time
@@ -176,7 +177,20 @@ class GenerateServer:
         self.admission = AdmissionController(max_queue, retry_after_s=retry_after_s)
         self.stats = ServeMetrics()
         self.metrics = metrics
-        self.tracer = tracer if tracer is not None else Tracer(service="serve")
+        if tracer is None:
+            # per-process JSONL sink (pid-suffixed: supervisor fleets run N
+            # replicas against one trace dir) so tools/trace_report.py can
+            # merge replica spans with the router's under one request id
+            trace_dir = os.environ.get("RELORA_TPU_TRACE_DIR")
+            tracer = Tracer(
+                service="serve",
+                jsonl_path=(
+                    os.path.join(trace_dir, f"serve_spans_{os.getpid()}.jsonl")
+                    if trace_dir
+                    else None
+                ),
+            )
+        self.tracer = tracer
         # thread the server's tracer + registry into the scheduler so
         # prefill/insert/decode spans carry the same request trace ids and
         # the per-phase histograms land on this /metrics endpoint (a

@@ -274,6 +274,10 @@ class ReplicaSupervisor:
     def _spawn(self, rep: _Replica, *, first: bool) -> None:
         self._remove_stale(rep)  # never route to a dead incarnation's port
         env = dict(os.environ)
+        # replica identity for the shared metrics schema: serve.py stamps
+        # every metrics.jsonl record with _source=<rid>, so fleet tooling can
+        # join a replica's log against the collector's store by source
+        env["RELORA_TPU_REPLICA_ID"] = rep.rid
         if first or self.env_overrides_respawn:
             env.update(self.env_overrides.get(rep.idx, {}))
         if rep.log_fh is None:
@@ -375,6 +379,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--drain-timeout-s", type=float, default=60.0)
     p.add_argument("--probe-interval-s", type=float, default=0.25)
     p.add_argument(
+        "--replica-env",
+        action="append",
+        default=[],
+        metavar="IDX:KEY=VALUE",
+        help="env override for one replica's FIRST incarnation only (drills: "
+        "arm a faults.py site on r0; the respawn comes back clean)",
+    )
+    p.add_argument(
+        "--fleet-cadence-s",
+        type=float,
+        default=1.0,
+        help="FleetCollector scrape cadence; <= 0 disables the collector",
+    )
+    p.add_argument(
+        "--fleet-persist",
+        default=None,
+        help="fleet series JSONL path (default <workdir>/fleet_series.jsonl)",
+    )
+    p.add_argument("--slo-config", default=None, help="JSON SLO config (docs/observability.md)")
+    p.add_argument(
         "command", nargs=argparse.REMAINDER, help="replica command (after --)"
     )
     args = p.parse_args(argv)
@@ -384,7 +408,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not command:
         raise SystemExit("pass the replica command after '--'")
 
-    from relora_tpu.serve.router import Router  # jax-free, like this module
+    env_overrides: Dict[int, Dict[str, str]] = {}
+    for spec in args.replica_env:
+        idx_s, _, kv = spec.partition(":")
+        key, _, value = kv.partition("=")
+        env_overrides.setdefault(int(idx_s), {})[key] = value
+
+    from relora_tpu.obs.fleet import FleetCollector  # jax-free, like this module
+    from relora_tpu.obs.slo import SLOEngine
+    from relora_tpu.serve.router import Router
 
     sup = ReplicaSupervisor(
         command,
@@ -395,14 +427,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         quarantine_after=args.quarantine_after,
         crash_window_s=args.crash_window_s,
         drain_timeout_s=args.drain_timeout_s,
+        env_overrides=env_overrides,
+        env_overrides_respawn=False,
     )
+
+    # fleet observability plane: the collector scrapes every replica plus the
+    # router itself into a SeriesStore, runs the SLO engine each round, and
+    # mounts /fleet/metrics + /fleet/series on the router front-end
+    collector: Optional[FleetCollector] = None
+    router_holder: Dict[str, Router] = {}
+
+    def fleet_endpoints() -> Dict[str, Tuple[str, Optional[int]]]:
+        eps: Dict[str, Tuple[str, Optional[int]]] = dict(sup.endpoints())
+        r = router_holder.get("router")
+        if r is not None and r.started.is_set():
+            eps["router"] = (args.router_host, r.port)
+        return eps
+
+    if args.fleet_cadence_s > 0:
+        collector = FleetCollector(
+            fleet_endpoints,
+            slo_engine=SLOEngine.from_config(args.slo_config),
+            cadence_s=args.fleet_cadence_s,
+            persist_path=args.fleet_persist
+            or os.path.join(args.workdir, "fleet_series.jsonl"),
+        )
+        sup.on_event = lambda event, idx, detail: collector.record_supervisor_event(
+            event, idx, str(detail)
+        )
+
     router = Router(
         sup.endpoints,
         host=args.router_host,
         port=args.router_port,
         probe_interval_s=args.probe_interval_s,
+        extra_routes=collector.handle_fleet_route if collector is not None else None,
     )
+    router_holder["router"] = router
     sup.start()
+    if collector is not None:
+        collector.start()
 
     def on_sigterm(signum, frame):
         logger.info("SIGTERM: rolling drain, then router shutdown")
@@ -432,6 +496,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         asyncio.run(_main())
     finally:
+        if collector is not None:
+            collector.stop()
         sup.stop()
     return 0
 

@@ -447,6 +447,7 @@ class Trainer:
             config=run_config,
             use_wandb=cfg.wandb,
             resume_id=self._wandb_id,
+            source="train",  # fleet series schema: obs/fleet.py joins this file
         )
         self._wandb_id = self.metrics.run_id
         # span tracer for the update loop (data_fetch / dispatch / metric_pull

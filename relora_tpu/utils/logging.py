@@ -85,10 +85,16 @@ class MetricsLogger:
         config: Optional[Mapping[str, Any]] = None,
         use_wandb: bool = False,
         resume_id: Optional[str] = None,
+        source: Optional[str] = None,
     ):
         self.enabled = _process_index() == 0
         self.run_name = run_name
         self.run_id = resume_id
+        # fleet series schema: when set, every record carries _source so the
+        # FleetCollector / fleet_report can ingest this metrics.jsonl next to
+        # scraped serving series (trainer passes "train"; serve.py passes its
+        # replica id)
+        self.source = source
         self._fh = None
         self._wandb = None
         # JSONL writes are line-atomic under this lock: the serving front-end
@@ -133,6 +139,8 @@ class MetricsLogger:
         if step is not None:
             record["_step"] = step
         record["_time"] = time.time()
+        if self.source is not None:
+            record["_source"] = self.source
         with self._lock:
             if self._fh is not None:
                 self._fh.write(json.dumps(record) + "\n")
@@ -186,6 +194,8 @@ class MetricsLogger:
         if step is not None:
             record["_step"] = step
         record["_time"] = time.time()
+        if self.source is not None:
+            record["_source"] = self.source
         with self._lock:
             if self._fh is not None:
                 self._fh.write(json.dumps(record) + "\n")

@@ -375,4 +375,130 @@ EOF
 kill -TERM "$SUP_PID"
 wait "$SUP_PID"   # exit 0 = rolling drain + router shutdown completed cleanly
 
+echo "=== 13. fleet observability plane: collector, SLO burn drill, fleet report ==="
+OBS_FLEET="$WORK/obs_fleet"
+rm -rf "$OBS_FLEET"; mkdir -p "$OBS_FLEET"
+rm -f "$WORK/obs_router_port"
+# compressed burn windows so the drill fires/clears in seconds, not hours
+cat > "$WORK/slo_drill.json" <<'JSON'
+{"slos": [{"name": "availability", "series": "up", "threshold": 1.0,
+           "bad_when": "lt", "objective": 0.9, "windows": [[20.0, 3.0, 2.0]]}]}
+JSON
+# replica 0's first incarnation is armed to os._exit mid-decode (the serving
+# fault drill); env_overrides_respawn=False means its respawn comes back clean
+python -m relora_tpu.serve.supervisor --replicas 2 --workdir "$OBS_FLEET" \
+    --router-port 0 --router-port-file "$WORK/obs_router_port" \
+    --backoff-base-s 0.2 --probe-interval-s 0.1 \
+    --fleet-cadence-s 0.2 --slo-config "$WORK/slo_drill.json" \
+    --replica-env "0:RELORA_TPU_FAULTS=serve_crash:at_token=6" -- \
+    python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --max-batch 2 --max-queue 8 --cache-size 64 --eos-id -1 &
+OBS_SUP_PID=$!
+for _ in $(seq 600); do [ -s "$WORK/obs_router_port" ] && break; sleep 0.2; done
+[ -s "$WORK/obs_router_port" ] || { echo "router never wrote its port"; kill "$OBS_SUP_PID"; exit 1; }
+python - "$(cat "$WORK/obs_router_port")" "$OBS_FLEET" <<'EOF'
+import json, sys, time, urllib.error, urllib.request
+
+port, fleet = sys.argv[1], sys.argv[2]
+base = f"http://127.0.0.1:{port}"
+series_path = f"{fleet}/fleet_series.jsonl"
+
+def healthz():
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read().decode())
+
+def wait_healthy(n, tries=600):
+    h = {}
+    for _ in range(tries):
+        h = healthz()
+        if h.get("healthy_replicas", 0) >= n:
+            return
+        time.sleep(0.2)
+    raise SystemExit(f"fleet never reached {n} healthy replicas: {h}")
+
+def availability_transitions():
+    """(state, _time) of persisted r0 availability burn transitions."""
+    out = []
+    try:
+        with open(series_path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail
+                if (rec.get("_event") == "slo_burn_alert"
+                        and rec.get("slo") == "availability"
+                        and rec.get("_source") == "r0"):
+                    out.append((rec["state"], rec["_time"]))
+    except OSError:
+        pass
+    return out
+
+def stream(max_new_tokens):
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": max_new_tokens}).encode(),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            resp.read()
+            return True
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return False  # mid-crash stream errors are the drill, not a failure
+
+wait_healthy(2)
+time.sleep(6)  # boot-time burn (replicas down while compiling) must clear
+fires0 = sum(1 for s, _ in availability_transitions() if s == "fire")
+
+# drive tokens until replica 0's armed crash lands (at_token=6)
+t_crash = None
+for _ in range(100):
+    stream(4)
+    if healthz().get("healthy_replicas", 2) < 2:
+        t_crash = time.time()
+        break
+    time.sleep(0.1)
+assert t_crash is not None, "armed replica never crashed"
+
+# the burn alert must FIRE while the replica is down...
+for _ in range(200):
+    fires = [(s, t) for s, t in availability_transitions() if s == "fire"]
+    if len(fires) > fires0:
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit(f"SLO burn alert never fired after the crash: {availability_transitions()}")
+
+# ...and CLEAR once the supervisor's respawn is healthy again
+wait_healthy(2)
+for _ in range(300):
+    trans = availability_transitions()
+    if trans and trans[-1][0] == "clear":
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit(f"SLO burn alert never cleared after recovery: {availability_transitions()}")
+
+# the collector's plane is mounted on the router front-end
+fm = urllib.request.urlopen(f"{base}/fleet/metrics", timeout=30).read().decode()
+assert "relora_fleet_scrape_rounds_total" in fm, fm[:400]
+assert "relora_fleet_source_r0_up 1" in fm, fm[:400]
+fs = json.load(urllib.request.urlopen(f"{base}/fleet/series?source=r0&series=up", timeout=30))
+assert fs["sources"]["r0"]["up"], fs
+assert any(o["slo"] == "availability" for o in fs["slo"]["objectives"]), fs["slo"]
+print("fleet drill OK: burn alert fired on crash, cleared after respawn")
+EOF
+kill -TERM "$OBS_SUP_PID"
+wait "$OBS_SUP_PID"
+# post-mortem: rebuild the fleet picture from the persisted store alone
+python tools/fleet_report.py "$OBS_FLEET/fleet_series.jsonl" --window-s 60 > "$WORK/fleet_report.txt"
+grep -q "== fleet health ==" "$WORK/fleet_report.txt"
+grep -q "== SLO / error budget ==" "$WORK/fleet_report.txt"
+grep -q "slo_burn_alert" "$WORK/fleet_report.txt"
+grep -q "supervisor_" "$WORK/fleet_report.txt"   # lifecycle events on the timeline
+head -40 "$WORK/fleet_report.txt"
+
 echo "SMOKE OK"

@@ -36,6 +36,7 @@ Examples::
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -289,7 +290,17 @@ def main(argv=None) -> int:
         from relora_tpu.serve.server import run_server
         from relora_tpu.utils.logging import MetricsLogger
 
-        metrics = MetricsLogger(run_dir=args.run_dir) if args.run_dir else None
+        # _source = replica identity (the supervisor sets RELORA_TPU_REPLICA_ID
+        # per replica) so fleet tooling can join this metrics.jsonl against
+        # the collector's scraped series by source
+        metrics = (
+            MetricsLogger(
+                run_dir=args.run_dir,
+                source=os.environ.get("RELORA_TPU_REPLICA_ID", "serve"),
+            )
+            if args.run_dir
+            else None
+        )
         if not args.no_warmup:
             logger.info("warming serving compiles (disable with --no-warmup)")
             report = engine.warmup(args.max_batch)

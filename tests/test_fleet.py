@@ -1,0 +1,426 @@
+"""Fleet observability plane: collector, SLO burn alerts, cross-process traces.
+
+Layers, cheapest first:
+
+- **Unit**: Prometheus-text parsing round-trips the repo's own exposition
+  renderer; cumulative-bucket quantiles match ``Histogram.quantile``; the
+  SeriesStore's retention, JSONL persistence/rotation, and torn-tail
+  recovery.
+- **Fake replicas**: the FleetCollector scraping scriptable ``/healthz`` +
+  ``/metrics`` stubs — derived rate/error/percentile series, health-flip
+  events, trainer-JSONL tailing, the ``/fleet/*`` route payloads.
+- **SLO engine**: multi-window burn-rate fire -> clear lifecycle on
+  synthetic series (events into store AND flight recorder), and the
+  anomaly path firing exactly where a bare ``LossSpikeDetector`` fires on
+  the same series.
+- **Acceptance**: a real 2-replica ``serve.py --random-init`` fleet behind
+  the Router with ``RELORA_TPU_TRACE_DIR`` set; the per-process span JSONLs
+  merge (tools/trace_report.py) into ONE tree per request id containing the
+  router's ``route`` span, the replica's ``request`` span, and model-thread
+  spans — the cross-process trace-joining contract.
+"""
+
+import http.server
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from relora_tpu.obs.fleet import (
+    FleetCollector,
+    SeriesStore,
+    histogram_quantile,
+    load_series_jsonl,
+    parse_prometheus,
+)
+from relora_tpu.obs.metrics import MetricsRegistry
+from relora_tpu.obs.slo import SLO, AnomalySpec, SLOEngine
+
+pytestmark = [pytest.mark.fleet]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- unit: exposition parsing -------------------------------------------------
+
+
+def test_parse_prometheus_round_trips_own_renderer():
+    """parse_prometheus inverts MetricsRegistry.render: plain and labelled
+    counters (flattened to ``name.labelval``), gauges, and histograms with
+    +Inf buckets, sum, and count."""
+    reg = MetricsRegistry(namespace="relora_serve")
+    reg.inc("requests_total", by=7)
+    reg.inc("requests_finished_total", ("reason", "length"), by=9)
+    reg.inc("requests_finished_total", ("reason", "error"), by=1)
+    reg.set_gauge("queue_depth", 3)
+    for v in (0.004, 0.004, 0.004, 0.004, 0.09):
+        reg.observe("ttft_seconds", v)
+    flat, hists = parse_prometheus(reg.render())
+    assert flat["relora_serve_requests_total"] == 7.0
+    assert flat["relora_serve_requests_finished_total.length"] == 9.0
+    assert flat["relora_serve_requests_finished_total.error"] == 1.0
+    assert flat["relora_serve_queue_depth"] == 3.0
+    h = hists["relora_serve_ttft_seconds"]
+    assert h["count"] == 5 and h["sum"] == pytest.approx(0.106)
+    assert h["buckets"][-1][0] == float("inf") and h["buckets"][-1][1] == 5
+    # quantile parity with the in-process Histogram on identical data
+    hist = reg.histogram("ttft_seconds")
+    assert histogram_quantile(h["buckets"], 0.50) == hist.quantile(0.50)
+    assert histogram_quantile(h["buckets"], 0.95) == hist.quantile(0.95)
+
+
+# -- unit: the series store ---------------------------------------------------
+
+
+def test_series_store_retention_and_queries():
+    store = SeriesStore(max_points=4)
+    for i in range(10):
+        store.add_samples("r0", {"up": float(i)}, t=100.0 + i, persist=False)
+    pts = store.samples("r0", "up")
+    assert len(pts) == 4  # ring retention
+    assert [v for _, v in pts] == [6.0, 7.0, 8.0, 9.0]
+    assert store.latest("r0", "up") == (109.0, 9.0)
+    assert store.window_values("r0", "up", 2.5, now=109.0) == [7.0, 8.0, 9.0]
+    assert store.sources() == ["r0"] and store.series_names("r0") == ["up"]
+
+
+def test_series_store_persistence_rotation_and_torn_tail(tmp_path):
+    """Records persist in the trainer's metrics.jsonl schema; the file
+    rotates at the byte cap; reload skips a torn tail line but keeps the
+    rotated predecessor's records."""
+    path = str(tmp_path / "fleet_series.jsonl")
+    store = SeriesStore(persist_path=path, persist_max_bytes=400)
+    for i in range(12):
+        store.add_samples("r0", {"up": 1.0, "queue": float(i)}, t=1000.0 + i)
+    store.add_event("health_flip", "r0", t=1012.0, frm="ok", to="stuck")
+    store.close()
+    assert os.path.exists(path + ".1")  # rotation happened
+    with open(path) as fh:
+        first = json.loads(fh.readline())
+    assert first["_source"] == "r0" and "_time" in first  # shared schema
+    with open(path, "a") as fh:
+        fh.write('{"up": 1.0, "_source": "r0", "_ti')  # torn tail
+    fresh = SeriesStore()
+    n = load_series_jsonl(fresh, path)
+    assert n == 13  # 12 sample records + 1 event, torn line skipped
+    assert len(fresh.samples("r0", "queue")) == 12  # rotated file included
+    assert fresh.events(kinds=("health_flip",))[0]["to"] == "stuck"
+
+
+# -- fake replicas: the collector --------------------------------------------
+
+
+class _ScrapeTarget:
+    """A scriptable /healthz + /metrics endpoint standing in for one
+    replica (or the router): tests flip ``healthy`` and rewrite
+    ``metrics_text`` between collector rounds."""
+
+    def __init__(self):
+        self.healthy = True
+        self.health_payload = {"status": "ok", "queue_depth": 2, "active_slots": 1}
+        self.metrics_text = ""
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if outer.healthy:
+                        code, payload = 200, outer.health_payload
+                    else:
+                        code, payload = 503, {"status": "stuck"}
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                else:
+                    code, body, ctype = 200, outer.metrics_text.encode(), "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(5)
+
+
+def _serve_metrics_text(finished_length=0, finished_error=0, ttfts=()):
+    reg = MetricsRegistry(namespace="relora_serve")
+    if finished_length:
+        reg.inc("requests_finished_total", ("reason", "length"), by=finished_length)
+    if finished_error:
+        reg.inc("requests_finished_total", ("reason", "error"), by=finished_error)
+    for v in ttfts:
+        reg.observe("ttft_seconds", v)
+    return reg.render()
+
+
+def test_collector_derives_series_and_flip_events(tmp_path):
+    """Two scripted replicas: scraped gauges land verbatim, counters grow
+    ``_per_s`` rate series, finish-reason counters collapse into
+    ``error_rate``, histograms become p50/p95, a 503 flip emits a
+    health_flip event, and an unpublished port scores down."""
+    a, b = _ScrapeTarget(), _ScrapeTarget()
+    try:
+        a.metrics_text = _serve_metrics_text(finished_length=10, ttfts=(0.004,) * 5)
+        b.metrics_text = _serve_metrics_text(finished_length=5)
+        eps = {"r0": ("127.0.0.1", a.port), "r1": ("127.0.0.1", b.port),
+               "r2": ("127.0.0.1", None)}
+        coll = FleetCollector(lambda: eps, persist_path=str(tmp_path / "f.jsonl"))
+        ups = coll.scrape_once(now=1000.0)
+        assert ups == {"r0": 1.0, "r1": 1.0, "r2": 0.0}
+        assert coll.store.latest("r0", "healthz_queue_depth")[1] == 2.0
+        assert coll.store.latest("r0", "relora_serve_ttft_seconds_p95")[1] > 0
+
+        # round 2: r0 progressed (+10 done, +2 error), r1 went unhealthy
+        a.metrics_text = _serve_metrics_text(
+            finished_length=20, finished_error=2, ttfts=(0.004,) * 5
+        )
+        b.healthy = False
+        coll.scrape_once(now=1002.0)
+        per_s = coll.store.latest("r0", "relora_serve_requests_finished_total.length_per_s")
+        assert per_s[1] == pytest.approx(5.0)  # +10 over 2s
+        assert coll.store.latest("r0", "error_rate")[1] == pytest.approx(2.0 / 12.0)
+        assert coll.store.latest("r1", "up")[1] == 0.0
+        flips = coll.store.events(kinds=("health_flip",))
+        assert [(e["_source"], e["frm"], e["to"]) for e in flips] == [
+            ("r1", "ok", "stuck")
+        ]
+
+        # the collector's own exposition + the mounted /fleet/* routes
+        rendered = coll.render_metrics()
+        assert "relora_fleet_scrape_rounds_total 2" in rendered
+        assert "relora_fleet_source_r1_up 0" in rendered
+        status, ctype, body = coll.handle_fleet_route("/fleet/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        status, ctype, body = coll.handle_fleet_route("/fleet/series?source=r0&series=up")
+        payload = json.loads(body)
+        assert [v for _, v in payload["sources"]["r0"]["up"]] == [1.0, 1.0]
+        assert coll.handle_fleet_route("/not/fleet") is None
+        coll.store.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_collector_tails_trainer_jsonl_with_torn_tail(tmp_path):
+    """The trainer's metrics.jsonl joins the store by tailing: complete new
+    lines land each round, a torn tail is deferred to the next round, and
+    records keep their own _time."""
+    path = str(tmp_path / "metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"loss": 2.5, "mfu": 0.31, "_step": 1, "_time": 50.0}) + "\n")
+        fh.write('{"loss": 2.4, "_step": 2')  # torn: writer mid-line
+    coll = FleetCollector(lambda: {}, jsonl_sources={"train": path})
+    coll.scrape_once(now=1000.0)
+    assert [v for _, v in coll.store.samples("train", "loss")] == [2.5]
+    assert coll.store.latest("train", "mfu") == (50.0, 0.31)
+    with open(path, "a") as fh:
+        fh.write(', "_time": 51.0}\n')  # the torn line completes
+    coll.scrape_once(now=1001.0)
+    assert [v for _, v in coll.store.samples("train", "loss")] == [2.5, 2.4]
+
+
+# -- the SLO engine -----------------------------------------------------------
+
+
+def test_slo_burn_alert_fires_and_clears():
+    """Google-SRE shape on a synthetic availability series: an outage deep
+    enough to burn both windows fires once; recovery of the SHORT window
+    clears it (the long window still remembers the outage — that must not
+    hold the alert open).  Transitions land in the store and the flight
+    recorder."""
+    from relora_tpu.obs.flight import default_recorder
+
+    store = SeriesStore()
+    slo = SLO(
+        name="availability", series="up", threshold=1.0, bad_when="lt",
+        objective=0.9, windows=((30.0, 5.0, 2.0),),
+    )
+    engine = SLOEngine([slo])
+    flight_before = len(default_recorder().events())
+    transitions = []
+    for i in range(60):
+        t = 1000.0 + i
+        up = 0.0 if 20 <= i < 35 else 1.0  # 15s outage
+        store.add_samples("r0", {"up": up}, t=t, persist=False)
+        for tr in engine.evaluate(store, now=t):
+            # a returned dict IS a transition; its post-transition state
+            # ("firing" / "ok") tells which edge it was
+            transitions.append((i, "fire" if tr["state"] == "firing" else "clear"))
+    states = [s for _, s in transitions]
+    assert states == ["fire", "clear"]
+    fire_i = transitions[0][0]
+    clear_i = transitions[1][0]
+    assert 20 <= fire_i < 35  # fired during the outage
+    assert clear_i >= 35  # cleared only after recovery
+    stored = store.events(kinds=("slo_burn_alert",))
+    assert [e["state"] for e in stored] == ["fire", "clear"]
+    assert stored[0]["burn_long"] >= 2.0 and stored[0]["burn_short"] >= 2.0
+    flight = default_recorder().events()[flight_before:]
+    assert [e["name"] for e in flight if e.get("name") == "slo_burn_alert"]
+    assert engine.active_alerts() == []
+    assert engine.status()["history"][0]["state"] == "cleared"
+
+
+def test_slo_engine_anomaly_parity_with_loss_spike_detector():
+    """The SLO engine's anomaly path IS LossSpikeDetector per (source,
+    series): on an identical loss series both fire at the same index, and
+    the engine emits a ``series_anomaly`` event with the detector's median
+    context."""
+    from relora_tpu.train.resilience import LossSpikeDetector
+
+    series = [2.0 + 0.01 * (i % 5) for i in range(40)]
+    for i in range(40, 44):
+        series.append(9.0)  # sustained spike: fires after patience=3
+
+    det = LossSpikeDetector(threshold=4.0, window=16, min_history=8, patience=3)
+    direct_fire = None
+    for i, v in enumerate(series):
+        if det.update(i, v) is not None:
+            direct_fire = i
+            break
+    assert direct_fire is not None
+
+    store = SeriesStore()
+    spec = AnomalySpec(
+        series="loss", source="train", threshold=4.0, window=16,
+        min_history=8, patience=3,
+    )
+    engine = SLOEngine([], anomalies=[spec])
+    engine_fire = None
+    for i, v in enumerate(series):
+        store.add_samples("train", {"loss": v}, t=1000.0 + i, persist=False)
+        fired = engine.evaluate(store, now=1000.0 + i)
+        if fired and engine_fire is None:
+            engine_fire = i
+            detail = fired[0]
+    assert engine_fire == direct_fire
+    events = store.events(kinds=("series_anomaly",))
+    assert events and events[0]["series"] == "loss"
+    assert events[0]["median"] == pytest.approx(
+        sorted(series[:16])[8], abs=0.1
+    ) or events[0]["median"] < 3.0  # median context from the detector
+
+
+# -- acceptance: cross-process trace joining ----------------------------------
+
+
+@pytest.mark.serve
+def test_merged_trace_one_tree_per_request(tmp_path, monkeypatch):
+    """A single request through the router produces, after merging the
+    router's and replicas' span JSONLs, ONE tree per request id holding the
+    router's ``route`` span, a replica's ``request`` span, and spans
+    recorded on the replica's model thread — all under the request-id trace
+    id (the PR's pinned acceptance criterion)."""
+    import asyncio
+
+    from relora_tpu.serve.router import Router
+    from relora_tpu.serve.supervisor import ReplicaSupervisor
+
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    # children inherit os.environ; the in-process Router reads it at
+    # construction — set it before either exists
+    monkeypatch.setenv("RELORA_TPU_TRACE_DIR", str(trace_dir))
+
+    sup = ReplicaSupervisor(
+        [
+            sys.executable, os.path.join(ROOT, "serve.py"),
+            "--model_config", "llama_9m", "--random-init",
+            "--max-batch", "4", "--max-queue", "16", "--no-warmup",
+        ],
+        2,
+        str(tmp_path / "fleet"),
+        backoff_base_s=0.1, backoff_jitter=0.0, poll_interval_s=0.05,
+    )
+    router = Router(
+        sup.endpoints, port=0, probe_interval_s=0.1,
+        retry_backoff_s=0.02, failure_threshold=2, cooldown_s=0.2,
+    )
+    rt = threading.Thread(target=lambda: asyncio.run(router.serve_forever()), daemon=True)
+    sup.start()
+    rt.start()
+    rids = []
+    try:
+        assert router.started.wait(10)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if sum(st.healthy for st in router.replicas.values()) >= 2:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("fleet never became healthy")
+
+        import http.client
+
+        for i in range(3):  # a few requests so both replicas likely serve
+            conn = http.client.HTTPConnection("127.0.0.1", router.port, timeout=60)
+            conn.request(
+                "POST", "/v1/generate",
+                json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            rid = resp.getheader("X-Request-Id")
+            assert rid
+            rids.append(rid)
+            resp.read()
+            conn.close()
+    finally:
+        router.begin_shutdown()
+        rt.join(10)
+        sup.stop()  # SIGTERM -> replicas drain, flushing their span sinks
+
+    stream_files = sorted(str(p) for p in trace_dir.glob("*_spans_*.jsonl"))
+    router_files = [p for p in stream_files if "router_spans" in p]
+    serve_files = [p for p in stream_files if "serve_spans" in p]
+    assert len(router_files) == 1 and len(serve_files) >= 2
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    streams = []
+    for path in stream_files:
+        spans, events, _ = trace_report.load(path)
+        streams.append((os.path.basename(path), spans, events))
+    spans, events = trace_report.merge_streams(streams)
+
+    for rid in rids:
+        tree = [s for s in spans if s.get("trace_id") == rid]
+        services = {s["service"] for s in tree}
+        assert services == {"router", "serve"}, (rid, services)
+        names = {(s["service"], s["name"]) for s in tree}
+        assert ("router", "route") in names
+        assert ("serve", "request") in names
+        model_spans = [
+            s for s in tree if s["service"] == "serve" and s["thread"] == "serve-model"
+        ]
+        assert model_spans, f"no model-thread spans under {rid}"
+        # wall-clock realignment: the router's root must start before any
+        # replica work on the same request
+        route = next(s for s in tree if s["name"] == "route")
+        assert all(s["t_start"] >= route["t_start"] - 0.05 for s in tree)
+
+    # the merged Chrome export groups spans by source process
+    chrome_path = str(tmp_path / "merged_chrome.json")
+    rc = trace_report.main([*stream_files, "--chrome", chrome_path])
+    assert rc == 0
+    chrome = json.load(open(chrome_path))["traceEvents"]
+    proc_names = {e["args"]["name"] for e in chrome if e.get("name") == "process_name"}
+    assert len(proc_names) == len(stream_files)

@@ -233,15 +233,17 @@ def test_tracer_jsonl_sink(tmp_path):
     tr = Tracer(service="t", recorder=FlightRecorder(), jsonl_path=str(path))
     with tr.span("a"):
         pass
-    tr.event("tick")  # events do not go to the JSONL sink, only spans
+    tr.event("tick")  # events go to the sink too, tagged so span readers can skip them
     with tr.span("b"):
         pass
     tr.close()
     lines = [json.loads(line) for line in path.read_text().splitlines()]
-    assert [rec["name"] for rec in lines] == ["a", "b"]
+    assert [rec["name"] for rec in lines] == ["a", "tick", "b"]
+    assert lines[1]["_event"] is True
+    assert [rec["name"] for rec in lines if not rec.get("_event")] == ["a", "b"]
     with tr.span("after_close"):  # close() drops the sink, not the tracer
         pass
-    assert len(path.read_text().splitlines()) == 2
+    assert len(path.read_text().splitlines()) == 3
 
 
 def test_noop_tracer_is_api_compatible():

@@ -190,12 +190,22 @@ def check_obs(bench_dir: str) -> List[str]:
     if not doc:
         return []
     detail = doc.get("detail") or {}
+    failures = []
     if detail.get("within_budget") is False:
-        return [
+        failures.append(
             f"obs overhead: {doc.get('value')}% of step time exceeds "
             f"budget {detail.get('budget_pct')}%"
-        ]
-    return []
+        )
+    collector = detail.get("collector") or {}
+    if collector.get("within_budget") is False:
+        failures.append(
+            f"fleet collector overhead: {collector.get('overhead_pct')}% "
+            f"serving throughput loss exceeds budget "
+            f"{collector.get('budget_pct')}% "
+            f"(off {collector.get('off_tok_s')} tok/s -> "
+            f"on {collector.get('on_tok_s')} tok/s)"
+        )
+    return failures
 
 
 def check_attn(bench_dir: str, tolerance: float) -> List[str]:

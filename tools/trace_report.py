@@ -37,21 +37,87 @@ from relora_tpu.obs.tracer import chrome_trace_events  # noqa: E402
 def load(path: str) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]], Dict[str, Any]]:
     """Return (spans, events, header) from a flight dump or a JSONL stream."""
     if path.endswith(".jsonl"):
-        spans = []
+        spans: List[Dict[str, Any]] = []
+        events: List[Dict[str, Any]] = []
         with open(path) as fh:
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    spans.append(json.loads(line))
+                    record = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn tail line from a killed writer
-        return spans, [], {"source": "jsonl"}
+                # instant events share the stream, flagged with _event
+                if record.pop("_event", None):
+                    events.append(record)
+                else:
+                    spans.append(record)
+        return spans, events, {"source": "jsonl"}
     with open(path) as fh:
         payload = json.load(fh)
     header = {k: v for k, v in payload.items() if k not in ("spans", "events")}
     return payload.get("spans", []), payload.get("events", []), header
+
+
+def merge_streams(
+    streams: List[Tuple[str, List[Dict[str, Any]], List[Dict[str, Any]]]],
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Join span streams from different processes into one timeline.
+
+    Each process numbers its spans independently ("s000001" collides across
+    files), so span/parent ids get a per-stream prefix — parent links stay
+    intra-process, while the shared ``trace_id`` (the router's X-Request-Id)
+    joins the trees.  Timestamps are per-process *monotonic* clocks with
+    unrelated origins; spans recorded since ``t_wall`` exists are shifted
+    onto the wall clock so router and replica phases interleave correctly.
+    Every span/event is tagged with ``_pid`` (stream index + 1) and
+    ``_stream`` (stream name) for the Chrome export's per-process grouping.
+    """
+    merged_spans: List[Dict[str, Any]] = []
+    merged_events: List[Dict[str, Any]] = []
+    for i, (name, spans, events) in enumerate(streams):
+        prefix = f"p{i}:"
+        for s in spans:
+            s = dict(s)
+            if s.get("span_id"):
+                s["span_id"] = prefix + str(s["span_id"])
+            if s.get("parent_id"):
+                s["parent_id"] = prefix + str(s["parent_id"])
+            t_wall = s.get("t_wall")
+            if isinstance(t_wall, (int, float)) and s.get("t_start") is not None:
+                shift = t_wall - s["t_start"]
+                s["t_start"] = t_wall
+                if s.get("t_end") is not None:
+                    s["t_end"] = s["t_end"] + shift
+            s["_pid"], s["_stream"] = i + 1, name
+            merged_spans.append(s)
+        for e in events:
+            e = dict(e)
+            if e.get("parent_id"):
+                e["parent_id"] = prefix + str(e["parent_id"])
+            if isinstance(e.get("t_wall"), (int, float)):
+                e["t"] = e["t_wall"]
+            e["_pid"], e["_stream"] = i + 1, name
+            merged_events.append(e)
+    # re-zero at the earliest stamp: wall-epoch microseconds confuse trace
+    # viewers and make the tree's ms column unreadable
+    t0 = min(
+        [s["t_start"] for s in merged_spans if s.get("t_start") is not None]
+        + [e["t"] for e in merged_events if e.get("t") is not None]
+        or [0.0]
+    )
+    for s in merged_spans:
+        if s.get("t_start") is not None:
+            s["t_start"] -= t0
+        if s.get("t_end") is not None:
+            s["t_end"] -= t0
+    for e in merged_events:
+        if e.get("t") is not None:
+            e["t"] -= t0
+    merged_spans.sort(key=lambda s: s.get("t_start") or 0.0)
+    merged_events.sort(key=lambda e: e.get("t") or 0.0)
+    return merged_spans, merged_events
 
 
 def percentile(sorted_vals: List[float], q: float) -> float:
@@ -86,6 +152,11 @@ def print_tree(spans: List[Dict[str, Any]], trace_id: str, out=sys.stdout) -> No
         group.sort(key=lambda s: s.get("t_start") or 0.0)
     roots = children.get(None, [])
     total = sum(s.get("dur_s") or 0.0 for s in roots) or None
+    # a cross-process trace (router + replica joined on one request id)
+    # qualifies span names with their service so the tree reads as a hop
+    # sequence; single-service traces render exactly as before
+    services = {s.get("service") for s in trace if s.get("service")}
+    qualify = len(services) > 1
     out.write(f"trace {trace_id}  ({len(trace)} spans)\n")
 
     def walk(span: Dict[str, Any], depth: int) -> None:
@@ -94,8 +165,11 @@ def print_tree(spans: List[Dict[str, Any]], trace_id: str, out=sys.stdout) -> No
         pct = ""
         if total and dur is not None:
             pct = f"  {100.0 * dur / total:5.1f}%"
+        name = span.get("name", "?")
+        if qualify:
+            name = f"{span.get('service', '?')}/{name}"
         out.write(
-            f"  {'  ' * depth}{span.get('name', '?')}  {dur_txt}{pct}"
+            f"  {'  ' * depth}{name}  {dur_txt}{pct}"
             f"{_fmt_attrs(span.get('attrs') or {})}\n"
         )
         for child in children.get(span["span_id"], []):
@@ -134,7 +208,12 @@ def phase_summary(spans: List[Dict[str, Any]], out=sys.stdout) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="flight_*.json dump or *.jsonl span stream")
+    ap.add_argument(
+        "paths", nargs="+", metavar="path",
+        help="flight_*.json dumps and/or *.jsonl span streams; several paths "
+        "are merged into one timeline joined on shared trace ids "
+        "(e.g. router_spans_*.jsonl + serve_spans_*.jsonl)",
+    )
     ap.add_argument("--trace", help="render only this trace id")
     ap.add_argument(
         "--max-traces", type=int, default=3,
@@ -143,12 +222,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--chrome", help="also export Chrome trace-event JSON here")
     args = ap.parse_args(argv)
 
-    spans, events, header = load(args.path)
-    if header.get("reason"):
-        out = sys.stdout
-        out.write(
-            f"flight dump: reason={header['reason']} pid={header.get('pid')} "
-            f"dropped_spans={header.get('dropped_spans', 0)}\n\n"
+    if len(args.paths) == 1:
+        spans, events, header = load(args.paths[0])
+        if header.get("reason"):
+            sys.stdout.write(
+                f"flight dump: reason={header['reason']} pid={header.get('pid')} "
+                f"dropped_spans={header.get('dropped_spans', 0)}\n\n"
+            )
+    else:
+        streams = []
+        for path in args.paths:
+            s, e, _ = load(path)
+            streams.append((Path(path).name, s, e))
+        spans, events = merge_streams(streams)
+        sys.stdout.write(
+            f"merged {len(args.paths)} streams: "
+            + " ".join(name for name, _, _ in streams) + "\n\n"
         )
     if not spans and not events:
         print("empty trace")
@@ -168,8 +257,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     phase_summary(spans)
 
     if args.chrome:
+        if len(args.paths) == 1:
+            trace_events = chrome_trace_events(spans, events)
+        else:
+            # one Chrome process per source stream, labelled with the file
+            # it came from, so Perfetto shows router and replicas as
+            # separate swim lanes on the shared wall-clock axis
+            trace_events = []
+            for i, (name, _, _) in enumerate(streams):
+                pid = i + 1
+                trace_events.extend(
+                    chrome_trace_events(
+                        [s for s in spans if s.get("_pid") == pid],
+                        [e for e in events if e.get("_pid") == pid],
+                        pid=pid,
+                    )
+                )
+                trace_events.append(
+                    {"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": name}}
+                )
         with open(args.chrome, "w") as fh:
-            json.dump({"traceEvents": chrome_trace_events(spans, events)}, fh)
+            json.dump({"traceEvents": trace_events}, fh)
         print(f"\nchrome trace written to {args.chrome}")
     return 0
 
