@@ -436,16 +436,16 @@ def serve_load_main(router: bool = False) -> None:
         else ["bf16"]
     )
 
-    def build_stack(kv_dtype: str):
+    def build_stack(kv_dtype: str, spec: str = "off", spec_k: int = 0):
         if paged:
             num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
             eng = InferenceEngine(
                 cfg, params, cache_size=cache_size,
                 page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
-                kv_dtype=kv_dtype,
+                kv_dtype=kv_dtype, spec_k=spec_k,
             )
             eng.warmup(max_batch)
-            sched = PagedContinuousBatchingScheduler(eng, max_batch=max_batch)
+            sched = PagedContinuousBatchingScheduler(eng, max_batch=max_batch, spec=spec)
         else:
             eng = InferenceEngine(cfg, params, cache_size=cache_size)
             buckets = sorted({prompt_len} | ({long_prompt_len} if long_share > 0 else set()))
@@ -783,6 +783,45 @@ def serve_load_main(router: bool = False) -> None:
         for kv_dtype in kv_dtypes[1:]:
             engine, scheduler, server = build_stack(kv_dtype)
             dtype_runs[kv_dtype] = dtype_entry(engine, asyncio.run(bench()))
+    # speculative-decoding sweep (paged only): each level rebuilds the stack
+    # on the headline kv_dtype with the given draft mode/K and reruns the
+    # load levels — "off" reuses the headline run (same configuration)
+    spec_runs = {}
+    if paged:
+        spec_levels = [
+            s.strip()
+            for s in os.environ.get(
+                "BENCH_HTTP_SPEC_LEVELS", "off,ngram:2,ngram:4,ngram:8"
+            ).split(",")
+            if s.strip()
+        ]
+
+        def spec_entry(run_rows, stats) -> dict:
+            pk = max(run_rows, key=lambda r: r["throughput_tokens_per_s"])
+            return {
+                "mode": stats["mode"],
+                "k": stats["k"],
+                "drafted": stats["drafted"],
+                "accepted": stats["accepted"],
+                "accept_rate": stats["accept_rate"],
+                "effective_tokens_per_s": pk["throughput_tokens_per_s"],
+                "ttft_p50_ms_at_peak": pk["ttft_p50_ms"],
+                "tpot_p50_ms_at_peak": pk["tpot_p50_ms"],
+                "levels": run_rows,
+            }
+
+        for level in spec_levels:
+            if level == "off":
+                spec_runs["off"] = spec_entry(
+                    rows,
+                    {"mode": "off", "k": 0, "drafted": 0, "accepted": 0, "accept_rate": 0.0},
+                )
+                continue
+            mode, _, kstr = level.partition(":")
+            engine, scheduler, server = build_stack(
+                kv_dtypes[0], spec=mode, spec_k=int(kstr or "4")
+            )
+            spec_runs[level] = spec_entry(asyncio.run(bench()), scheduler.spec_stats())
     router_detail = router_phase() if router else None
     peak = max(rows, key=lambda r: r["throughput_tokens_per_s"])
     saturated = max(rows, key=lambda r: r["reject_rate"])
@@ -811,6 +850,7 @@ def serve_load_main(router: bool = False) -> None:
                     "chunk_size": engine.chunk_size,
                     "kv_dtype": kv_dtypes[0],
                     "kv_dtype_runs": dtype_runs,
+                    "spec_runs": spec_runs,
                 }
                 if paged
                 else {}

@@ -428,6 +428,8 @@ class FleetCollector:
         flat, hists = parse_prometheus(text)
         finished_total = 0.0
         finished_bad = 0.0
+        spec_drafted = None
+        spec_accepted = None
         for name, value in flat.items():
             values[name] = value
             if name.endswith("_total") or "_total." in name:
@@ -441,6 +443,12 @@ class FleetCollector:
                     finished_total += delta
                     if name.endswith(".error"):
                         finished_bad += delta
+                # per-replica speculative accept rate from counter deltas
+                # (falls back to lifetime totals on the first scrape)
+                if name.endswith("spec_drafted_total"):
+                    spec_drafted = max(0.0, value - prev[1]) if prev is not None else value
+                elif name.endswith("spec_accepted_total"):
+                    spec_accepted = max(0.0, value - prev[1]) if prev is not None else value
             if "group_" in name and name.endswith("_healthy"):
                 prev_g = self._last_gauges.get((source, name))
                 if prev_g is not None and prev_g != value:
@@ -452,6 +460,10 @@ class FleetCollector:
             values["error_rate"] = finished_bad / finished_total
         elif any("requests_finished_total" in k for k in flat):
             values["error_rate"] = 0.0
+        if spec_drafted is not None:
+            values["spec_accept_rate"] = (
+                (spec_accepted or 0.0) / spec_drafted if spec_drafted > 0 else 0.0
+            )
         for name, h in hists.items():
             values[f"{name}_p50"] = histogram_quantile(h["buckets"], 0.50)
             values[f"{name}_p95"] = histogram_quantile(h["buckets"], 0.95)

@@ -244,29 +244,32 @@ def paged_cached_attention(
 def _paged_decode_kernel(
     # scalar-prefetch operands (SMEM)
     bt_ref,  # (B, W) int32 block tables
-    pos_ref,  # (B,) int32 decode positions
+    pos_ref,  # (B, S) int32 per-query-token positions
     # VMEM inputs
-    q_ref,  # (1, N, H) this row's query
+    q_ref,  # (1, N*S, H) this row's queries, head-major (row = head*S + s)
     k_ref,  # (1, ps, n_kv, H) pool page selected by bt[b, w]
     v_ref,  # (1, ps, n_kv, H)
     ks_ref,  # (1, n_kv) f32 page scales (ones when unquantized)
     vs_ref,  # (1, n_kv)
     # VMEM output
-    o_ref,  # (1, N, H)
+    o_ref,  # (1, N*S, H)
     # VMEM scratch, carried across the W grid steps of one row
-    acc_ref,  # (N, H) f32 running numerator
-    m_ref,  # (N, 1) f32 running max
-    l_ref,  # (N, 1) f32 running denominator
+    acc_ref,  # (N*S, H) f32 running numerator
+    m_ref,  # (N*S, 1) f32 running max
+    l_ref,  # (N*S, 1) f32 running denominator
     *,
     sm_scale: float,
     page_size: int,
     n_kv: int,
+    q_len: int,
     quantized: bool,
 ):
     b = pl.program_id(0)
     w = pl.program_id(1)
     n_pages = pl.num_programs(1)
-    g = q_ref.shape[1] // n_kv
+    S = q_len
+    g = q_ref.shape[1] // (n_kv * S)
+    gS = g * S
 
     @pl.when(w == 0)
     def _init():
@@ -274,11 +277,19 @@ def _paged_decode_kernel(
         m_ref[...] = jnp.full_like(m_ref, -1e30)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    pos = pos_ref[b]
     # absolute token index of each slot in this page; (1, ps) because TPU
     # requires >=2D iota
     idx = w * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-    visible = idx <= pos  # (1, ps)
+    # per-query-token visibility: S is a small static int, so S scalar SMEM
+    # reads build the (S, 1) position column; broadcast against idx and tile
+    # over the g heads of a group to match the head-major row order
+    poss = jnp.concatenate(
+        [pos_ref[b, s].reshape(1, 1) for s in range(S)], axis=0
+    )  # (S, 1)
+    visible_s = idx <= poss  # (S, ps)
+    visible = jnp.broadcast_to(visible_s[None], (g, S, page_size)).reshape(
+        gS, page_size
+    )
 
     for j in range(n_kv):
         kj = k_ref[0, :, j, :].astype(jnp.float32)  # (ps, H)
@@ -286,28 +297,28 @@ def _paged_decode_kernel(
         if quantized:
             kj = kj * ks_ref[0, j]
             vj = vj * vs_ref[0, j]
-        qj = q_ref[0, j * g : (j + 1) * g, :].astype(jnp.float32)  # (g, H)
+        qj = q_ref[0, j * gS : (j + 1) * gS, :].astype(jnp.float32)  # (gS, H)
         s = (
             jax.lax.dot_general(
                 qj, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
             * sm_scale
-        )  # (g, ps)
+        )  # (gS, ps)
         s = jnp.where(visible, s, -1e30)
 
-        m_prev = m_ref[j * g : (j + 1) * g, :]  # (g, 1)
-        l_prev = l_ref[j * g : (j + 1) * g, :]
+        m_prev = m_ref[j * gS : (j + 1) * gS, :]  # (gS, 1)
+        l_prev = l_ref[j * gS : (j + 1) * gS, :]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)  # (g, 1)
+        alpha = jnp.exp(m_prev - m_new)  # (gS, 1)
         # mask p itself, not just the logits: if every slot of a page is
         # hidden, exp(-1e30 - m) could still round to nonzero garbage
-        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)  # (g, ps)
-        m_ref[j * g : (j + 1) * g, :] = m_new
-        l_ref[j * g : (j + 1) * g, :] = l_prev * alpha + jnp.sum(
+        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)  # (gS, ps)
+        m_ref[j * gS : (j + 1) * gS, :] = m_new
+        l_ref[j * gS : (j + 1) * gS, :] = l_prev * alpha + jnp.sum(
             p, axis=1, keepdims=True
         )
-        acc_ref[j * g : (j + 1) * g, :] = acc_ref[
-            j * g : (j + 1) * g, :
+        acc_ref[j * gS : (j + 1) * gS, :] = acc_ref[
+            j * gS : (j + 1) * gS, :
         ] * alpha + jax.lax.dot_general(
             p, vj, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -329,7 +340,7 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Fused single-token decode attention straight out of the page pool.
+    """Fused small-S decode/verify attention straight out of the page pool.
 
     One Pallas launch over grid ``(B, W)``: the block table rides in as a
     scalar-prefetch operand, so each grid step's BlockSpec index map picks
@@ -338,23 +349,31 @@ def paged_decode_attention(
     :func:`paged_cached_attention` never exists in HBM.  Scores stay in
     registers/VMEM as flash-style online-softmax state (running max ``m``,
     denominator ``l``, numerator ``acc`` carried across the W steps of a
-    row), so the ``(B, N, 1, S_kv)`` score matrix never exists either.
+    row), so the ``(B, N, S, S_kv)`` score matrix never exists either.
 
     With ``k_scale``/``v_scale`` the pool is int8 and each page is
     dequantized in VMEM by its own ``(page, kv_head)`` scale after the DMA —
     HBM traffic per cached token drops to 1 byte per element plus the
     per-page scales.
 
-    ``q`` is ``(B, 1, N, H)`` (decode only; chunked prefill keeps the naive
-    arm), ``positions`` ``(B,)`` or ``(B, 1)``.  Returns ``(B, 1, N, H)``
-    in ``q.dtype``; math is f32 like every decode path here.  Off-TPU use
+    ``q`` is ``(B, S, N, H)`` for a *small* static S — 1 for plain decode,
+    ``K+1`` for the speculative-decoding verify window (the dispatcher caps
+    the fused arm at small S; long chunked prefill keeps the naive arm).
+    Queries lay out head-major ``(B, N*S, H)`` inside the kernel so each
+    kv-head group stays one contiguous row block, and per-token positions
+    ride in as SMEM scalars to build the ``j <= position`` visibility mask
+    per query row.  Each query row's online-softmax state is independent
+    and walks the W pages in the same order regardless of S, so S=1
+    reproduces the original decode kernel exactly.
+
+    ``positions`` is ``(B,)``/``(B, 1)`` (broadcast — every query at the
+    same position) or ``(B, S)`` per-token.  Returns ``(B, S, N, H)`` in
+    ``q.dtype``; math is f32 like every decode path here.  Off-TPU use
     ``interpret=True`` (differential tests); numerics match the naive arm
     to f32 tolerance, not bitwise — online softmax sums in a different
     order.
     """
     B, T, N, H = q.shape
-    if T != 1:
-        raise ValueError(f"paged_decode_attention is decode-only (T=1), got T={T}")
     num_pages, page_size, n_kv, _ = pool_k.shape
     W = block_tables.shape[1]
     if N % n_kv:
@@ -372,9 +391,14 @@ def paged_decode_attention(
         ks = jnp.ones((num_pages, n_kv), jnp.float32)
         vs = ks
 
-    q3 = q.reshape(B, N, H)
+    # head-major rows: (B, S, N, H) -> (B, N, S, H) -> (B, N*S, H); row
+    # n*S + s holds query token s of head n, so kv-head j's group block is
+    # the contiguous slice [j*g*S, (j+1)*g*S)
+    q3 = q.transpose(0, 2, 1, 3).reshape(B, N * T, H)
     bt = block_tables.astype(jnp.int32)
-    pos = jnp.broadcast_to(positions.reshape(B, -1)[:, :1], (B, 1)).reshape(B)
+    pos = jnp.broadcast_to(positions.reshape(B, -1)[:, :1], (B, T)) if (
+        positions.size == B
+    ) else positions.reshape(B, T)
     pos = pos.astype(jnp.int32)
 
     kernel = functools.partial(
@@ -382,13 +406,14 @@ def paged_decode_attention(
         sm_scale=float(scale),
         page_size=page_size,
         n_kv=n_kv,
+        q_len=T,
         quantized=quantized,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, W),
         in_specs=[
-            pl.BlockSpec((1, N, H), lambda b, w, bt, pos: (b, 0, 0)),
+            pl.BlockSpec((1, N * T, H), lambda b, w, bt, pos: (b, 0, 0)),
             pl.BlockSpec(
                 (1, page_size, n_kv, H), lambda b, w, bt, pos: (bt[b, w], 0, 0, 0)
             ),
@@ -398,20 +423,20 @@ def paged_decode_attention(
             pl.BlockSpec((1, n_kv), lambda b, w, bt, pos: (bt[b, w], 0)),
             pl.BlockSpec((1, n_kv), lambda b, w, bt, pos: (bt[b, w], 0)),
         ],
-        out_specs=pl.BlockSpec((1, N, H), lambda b, w, bt, pos: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, N * T, H), lambda b, w, bt, pos: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((N, H), jnp.float32),
-            pltpu.VMEM((N, 1), jnp.float32),
-            pltpu.VMEM((N, 1), jnp.float32),
+            pltpu.VMEM((N * T, H), jnp.float32),
+            pltpu.VMEM((N * T, 1), jnp.float32),
+            pltpu.VMEM((N * T, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, N, H), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, N * T, H), q.dtype),
         interpret=interpret,
     )(bt, pos, q3, pool_k, pool_v, ks, vs)
-    return out.reshape(B, T, N, H)
+    return out.reshape(B, N, T, H).transpose(0, 2, 1, 3)
 
 
 def dot_product_attention(

@@ -15,9 +15,12 @@ territory, in the :mod:`relora_tpu.ops.lora_dispatch` mold:
   masking, so it never serves the paged pool — it is modeled here so one
   cost table ranks every attention arm the repo has.
 - **paged_decode** — :func:`relora_tpu.ops.attention.paged_decode_attention`:
-  single-token decode straight out of the page pool through the block
-  table, one launch, no gathered copy, no score matrix, optional in-VMEM
-  int8 dequant.  TPU-only for auto (the interpreter is a correctness tool).
+  small-S decode straight out of the page pool through the block table —
+  S == 1 plain decode or the speculative-decoding ``(B, K+1)`` verify
+  window (``PAGED_DECODE_MAX_S`` bounds it; long chunked-prefill shapes
+  stay naive) — one launch, no gathered copy, no score matrix, optional
+  in-VMEM int8 dequant.  TPU-only for auto (the interpreter is a
+  correctness tool).
 
 :func:`choose_arm` ranks arms with the same ``t(arm) = max(bytes/BW,
 flops/peak) + launches·t_launch`` roofline over static python ints
@@ -68,6 +71,12 @@ __all__ = [
 ]
 
 ARMS: Tuple[str, ...] = ("naive", "flash", "paged_decode")
+
+#: largest query length the fused paged kernel serves: covers plain decode
+#: (S=1) and every speculative verify window (K+1 for K <= 15) while the
+#: per-row VMEM state (N*S rows of online-softmax scratch) stays small;
+#: chunked prefill at the default chunk_size=64 keeps the naive arm
+PAGED_DECODE_MAX_S = 16
 
 #: arms a training forward can execute (attention.dot_product_attention
 #: impls; "flash" maps to impl="pallas" there)
@@ -147,10 +156,12 @@ def choose_arm(
 ) -> str:
     """Pick the cheapest *applicable* arm under the roofline model.
 
-    Applicability is structural, not modeled: ``paged_decode`` only exists
-    for single-token decode (S == 1); ``flash`` only for pure causal
-    self-attention with 128-aligned lengths (S == S_kv, tileable) — the
-    cache-visibility mask of chunked prefill is not expressible in it.
+    Applicability is structural, not modeled: ``paged_decode`` serves
+    small-S queries only (``S <= PAGED_DECODE_MAX_S`` — single-token decode
+    and the speculative verify window; its per-row VMEM softmax state
+    scales with heads×S); ``flash`` only for pure causal self-attention
+    with 128-aligned lengths (S == S_kv, tileable) — the cache-visibility
+    mask of chunked prefill is not expressible in it.
     ``fused_available=False`` (non-TPU backend, or caller opt-out) strikes
     both Pallas arms; ``allow`` restricts the candidate set (tests pin
     arms with it).  Pure python over static ints — trace-safe.
@@ -159,7 +170,7 @@ def choose_arm(
         B, S, S_kv, heads, kv_heads, head_dim, page_size, kv_bytes
     )
     candidates = [arm for arm in allow if arm in ARMS]
-    if S != 1 or not fused_available:
+    if S > PAGED_DECODE_MAX_S or not fused_available:
         candidates = [a for a in candidates if a != "paged_decode"]
     if S != S_kv or flash_block_size(S, S_kv) is None or not fused_available:
         candidates = [a for a in candidates if a != "flash"]
@@ -282,8 +293,9 @@ def paged_attention(
 
     The execution entry point used by the model cache-write path
     (models/llama.attend_with_paged_cache).  ``arm="auto"`` consults
-    :func:`choose_arm` with the static trace-time shapes; chunked prefill
-    (T > 1) always resolves to the naive arm, single-token decode takes the
+    :func:`choose_arm` with the static trace-time shapes; long chunked
+    prefill resolves to the naive arm, while single-token decode and the
+    small-S speculative verify window (T <= PAGED_DECODE_MAX_S) take the
     fused kernel on TPU.  Explicit ``arm=`` bypasses the model; the flash
     arm is not servable from a pool and is rejected here.
     """

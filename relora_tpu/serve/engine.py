@@ -154,6 +154,7 @@ class InferenceEngine:
         num_pages: Optional[int] = None,
         chunk_size: int = 64,
         kv_dtype: str = "bf16",
+        spec_k: int = 0,
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
@@ -161,6 +162,11 @@ class InferenceEngine:
             raise ValueError(f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}")
         if kv_dtype == "int8" and page_size is None:
             raise ValueError("kv_dtype='int8' requires the paged engine (page_size set)")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k and page_size is None:
+            raise ValueError("spec_k > 0 requires the paged engine (page_size set)")
+        self.spec_k = spec_k
         # "bf16" means the pool stores at the engine compute dtype
         # (unquantized — bf16 in the serving default, f32 in CPU tests, so
         # the bitwise paged-vs-contiguous parity invariant is untouched);
@@ -302,6 +308,13 @@ class InferenceEngine:
             )
             self._decode_paged = cw.wrap(
                 "decode_paged", jax.jit(decode_paged_fn, donate_argnums=(1,))
+            )
+            # speculative verify shares prefill_chunk's contract — a
+            # multi-token forward returning FULL window logits — but runs at
+            # (B, spec_k+1) with per-row positions and a W+1-wide table, so
+            # it gets its own watcher entry and jit cache
+            self._verify_paged = cw.wrap(
+                "verify_paged", jax.jit(prefill_chunk_fn, donate_argnums=(3,))
             )
 
     # -- cache construction --------------------------------------------------
@@ -487,6 +500,32 @@ class InferenceEngine:
             jnp.asarray(block_tables, jnp.int32),
         )
 
+    def verify_paged(
+        self, pool: PyTree, tokens: jax.Array, pos: jax.Array, block_tables
+    ) -> Tuple[jax.Array, PyTree]:
+        """Speculative verify step: ``tokens``/``pos`` are ``(B, S)`` with
+        ``S = spec_k + 1`` (last committed token followed by the drafted
+        candidates, at consecutive positions), ``block_tables`` is
+        ``(B, W+1)`` — the request's table plus a trailing null column so
+        any write past ``cache_size`` (padding rows, drafts beyond a row's
+        remaining budget) clips into the null page instead of a live one.
+        Rows without an active decoding request carry all-null tables and
+        ``pos = cache_size`` everywhere.  Returns FULL window logits
+        ``(B, S, V)`` (row ``i`` judges drafted token ``i+1``; the last row
+        is the bonus distribution) and the updated pool (input donated).
+        Rejected drafts need no pool rollback: their K/V land inside the
+        request's worst-case admission allocation (or the null page) and are
+        overwritten by the next round's forward before any query can attend
+        them."""
+        self._require_paged()
+        return self._verify_paged(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(pos, jnp.int32),
+            pool,
+            jnp.asarray(block_tables, jnp.int32),
+        )
+
     def default_prompt_buckets(self) -> Tuple[int, ...]:
         """Every prefill shape a prompt can actually land in: powers of two
         from the bucket minimum up, capped at ``cache_size`` (which is
@@ -533,16 +572,28 @@ class InferenceEngine:
                     jnp.zeros((batch, 1), jnp.int32),
                     jnp.zeros((batch, self.block_table_width), jnp.int32),
                 )
+                if self.spec_k > 0:
+                    S = self.spec_k + 1
+                    logits, pool = self.verify_paged(
+                        pool,
+                        jnp.zeros((batch, S), jnp.int32),
+                        jnp.full((batch, S), self.cache_size, jnp.int32),
+                        jnp.zeros((batch, self.block_table_width + 1), jnp.int32),
+                    )
                 jax.block_until_ready(logits)
             events = cw.compile_events()[n_before:]
+            shapes = {
+                "prefill_chunk": [1, self.chunk_size],
+                "decode_paged": [batch, 1],
+            }
+            if self.spec_k > 0:
+                shapes["verify_paged"] = [batch, self.spec_k + 1]
             return {
                 "batch": batch,
                 "prompt_buckets": [],
                 "kv_dtype": self.kv_dtype,
-                "shapes": {
-                    "prefill_chunk": [1, self.chunk_size],
-                    "decode_paged": [batch, 1],
-                },
+                "spec_k": self.spec_k,
+                "shapes": shapes,
                 "n_compiles": len(events),
                 "compiles": [
                     {"fn": ev.fn, "duration_s": round(ev.duration_s, 4), "reason": ev.reason}
@@ -618,6 +669,16 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((batch, 1), i32),
                 jax.ShapeDtypeStruct((batch, self.block_table_width), i32),
             )
+            if self.spec_k > 0:
+                S = self.spec_k + 1
+                plans["verify_paged"] = obs_memory.plan_for(
+                    self._verify_paged,
+                    self.params,
+                    jax.ShapeDtypeStruct((batch, S), i32),
+                    jax.ShapeDtypeStruct((batch, S), i32),
+                    pool,
+                    jax.ShapeDtypeStruct((batch, self.block_table_width + 1), i32),
+                )
             return plans
         if prompt_buckets is None:
             prompt_buckets = self.default_prompt_buckets()

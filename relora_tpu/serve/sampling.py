@@ -15,6 +15,16 @@ nucleus filter, then temperature-scaled categorical.  Everything traces under
   ``(B, key_size)`` batch of per-row keys.  Per-row keys make a request's
   sample stream independent of which other requests happen to share its
   batch — fold in the request id, not the slot index.
+
+``spec_verify_draws`` is the speculative-decoding verify sampler: one jitted
+pass over the verify window's ``(B, S, V)`` logits that produces everything
+the scheduler's host-side accept/rollback walk needs — greedy accept bits,
+rejection-sampling accept bits (uniform vs the *filtered* target probability
+of each drafted token), and per-row alternative tokens (residual sample on
+rejection, plain sample for the bonus position).  All PRNG keys derive from
+the same ``(uid, token_index)`` scheme the plain decode path uses, folded
+with small constants per draw kind, so a request's committed stream stays
+independent of batch composition and of how many drafts rode along.
 """
 
 from __future__ import annotations
@@ -91,3 +101,108 @@ def sample(
     else:
         drawn = jax.random.categorical(key, scaled)
     return jnp.where(temp <= 0.0, greedy, drawn)
+
+
+#: fold_in constants separating the verify round's PRNG draws per
+#: (uid, token_index): 1 = acceptance uniform, 2 = residual/bonus sample.
+#: Each (uid, token_index, kind) is consumed at most once over a request's
+#: lifetime — a rejected round never commits the indices past the rejection,
+#: and the round that commits an index is the only round whose walk uses its
+#: draws — so reuse across rounds never correlates committed samples.
+_SPEC_ACCEPT = 1
+_SPEC_ALT = 2
+
+
+def spec_verify_draws(
+    logits: jax.Array,
+    draft: jax.Array,
+    base_key: jax.Array,
+    uids: jax.Array,
+    start_index: jax.Array,
+    k_eff: jax.Array,
+    *,
+    temperature,
+    top_k: int = 0,
+    top_p=1.0,
+):
+    """Everything the speculative accept/rollback walk needs, in one jit.
+
+    Inputs: ``logits`` ``(B, S, V)`` from the verify forward (row ``i``
+    predicts generated-token index ``start_index + i``), ``draft`` ``(B,
+    S-1)`` the drafted candidates (``draft[:, i]`` judged by logits row
+    ``i``), ``uids``/``start_index``/``k_eff`` ``(B,)`` int32 — request id,
+    index of the first token this window can commit, and how many leading
+    draft entries are real (the rest is padding).  ``temperature``/``top_p``
+    broadcast per-row like :func:`sample`; ``top_k`` is static.
+
+    Returns ``(accept, alt)``:
+
+    - ``accept`` ``(B, S-1)`` bool — greedy rows accept iff the draft equals
+      the row argmax; sampled rows accept with probability ``p(draft)``
+      under the *same* filtered target distribution :func:`sample` draws
+      from (top-k → top-p → temperature), the textbook deterministic-
+      proposal rejection rule, so the committed marginal is exactly the
+      target distribution.
+    - ``alt`` ``(B, S)`` int32 — the token to commit when the walk stops at
+      row ``i``: for ``i < k_eff`` the residual sample (target with the
+      rejected draft token removed, renormalized); for ``i == k_eff`` a
+      plain target sample (the bonus after full acceptance).  Greedy rows
+      get the row argmax everywhere.
+
+    Host walk per row: ``a`` = leading accepts among the first ``k_eff``
+    entries; commit ``draft[:a]`` then ``alt[a]``.
+    """
+    logits = logits.astype(jnp.float32)
+    B, S, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)  # (B, S)
+
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    top_p_b = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+    flat = logits.reshape(B * S, V)
+    filtered = top_k_mask(flat, top_k)
+    filtered = top_p_mask(
+        filtered, jnp.repeat(top_p_b, S)
+    ).reshape(B, S, V)
+    scaled = filtered / jnp.maximum(temp, 1e-6)[:, None, None]
+    probs = jax.nn.softmax(scaled, axis=-1)  # (B, S, V) the target p
+
+    # per-(row, window-slot) keys: the SAME (uid, token_index) stream the
+    # plain decode path folds, built in-device to avoid B*S host fold_ins
+    def row_keys(uid, start):
+        def one(i):
+            return jax.random.fold_in(jax.random.fold_in(base_key, uid), start + i)
+
+        return jax.vmap(one)(jnp.arange(S, dtype=jnp.int32))
+
+    keys = jax.vmap(row_keys)(uids.astype(jnp.int32), start_index.astype(jnp.int32))
+
+    accept_keys = jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, _SPEC_ACCEPT)))(
+        keys
+    )
+    alt_keys = jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, _SPEC_ALT)))(keys)
+
+    # acceptance: rows 0..S-2 judge draft[:, 0..S-2]
+    p_draft = jnp.take_along_axis(probs[:, :-1, :], draft[..., None], axis=-1)[..., 0]
+    u = jax.vmap(jax.vmap(jax.random.uniform))(accept_keys[:, :-1])
+    accept_sampled = u < p_draft
+    accept_greedy = greedy[:, :-1] == draft
+    accept = jnp.where((temp <= 0.0)[:, None], accept_greedy, accept_sampled)
+
+    # alternative tokens: residual (draft slot zeroed, renormalized) where a
+    # real draft exists, plain target at the bonus slot; categorical over
+    # log-probs is invariant to the normalizer, so masking the scaled logits
+    # IS the renormalized residual draw
+    slot = jnp.arange(S, dtype=jnp.int32)[None, :]  # (1, S)
+    has_draft = slot < k_eff.astype(jnp.int32)[:, None]  # (B, S)
+    draft_full = jnp.concatenate(
+        [draft, jnp.zeros((B, 1), draft.dtype)], axis=1
+    )  # (B, S); last col unused (has_draft is False there)
+    onehot = jax.nn.one_hot(draft_full, V, dtype=bool)
+    residual_logits = jnp.where(
+        has_draft[..., None] & onehot, _NEG_INF, scaled
+    )
+    alt_sampled = jax.vmap(jax.vmap(jax.random.categorical))(
+        alt_keys, residual_logits
+    )
+    alt = jnp.where((temp <= 0.0)[:, None], greedy, alt_sampled)
+    return accept, alt

@@ -58,7 +58,7 @@ import numpy as np
 from relora_tpu.obs.tracer import NoopTracer
 from relora_tpu.serve.engine import InferenceEngine, bucket_length
 from relora_tpu.serve.paging import PageAllocator, PrefixCache, pages_needed
-from relora_tpu.serve.sampling import SamplingParams
+from relora_tpu.serve.sampling import SamplingParams, spec_verify_draws
 from relora_tpu.utils.logging import MetricsLogger, get_logger
 
 logger = get_logger(__name__)
@@ -72,13 +72,17 @@ FinishCallback = Callable[["Completion"], None]
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request: token-id prompt plus per-request sampling.
-    ``top_k`` is batch-global (static shape) and lives on the scheduler."""
+    ``top_k`` is batch-global (static shape) and lives on the scheduler.
+    ``spec`` opts this request out of speculative drafting (``False``) when
+    the scheduler runs with it on — output distribution is identical either
+    way; turning it off just skips the draft/verify work for this row."""
 
     uid: int
     prompt: Sequence[int]
     max_new_tokens: int
     temperature: float = 0.0
     top_p: float = 1.0
+    spec: bool = True
 
 
 @dataclasses.dataclass
@@ -580,7 +584,31 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
     to the contiguous path (ops/attention.paged_cached_attention), so a
     drain through this scheduler is token-identical to the contiguous one
     for the same request stream (pinned by tests/test_paging.py).
+
+    ``spec="ngram"`` (engine built with ``spec_k >= 1``) turns each decode
+    round into a draft→verify→accept round: a prompt-lookup drafter proposes
+    up to ``spec_k`` continuation tokens per row from the row's own
+    prompt+generated context, one ``(batch, spec_k+1)`` verify forward
+    scores the whole window, and a host-side walk commits the longest
+    accepted prefix plus one corrective token — so an accepting row emits up
+    to ``spec_k+1`` tokens for one forward's worth of HBM traffic (decode is
+    memory-bound; the window reuses the same weight/KV stream).  Greedy rows
+    accept by argmax match, so their output is token-identical to the
+    non-speculative path (pinned by tests/test_spec.py); sampled rows use
+    rejection sampling against the same filtered target distribution
+    ``sample()`` draws from, keyed by the same ``(uid, token_index)``
+    scheme, so their outputs stay exactly target-distributed.  Rejected
+    drafts need no pool rollback: every window write lands inside the
+    request's worst-case admission allocation (or the null page, via the
+    verify table's trailing null column) and is overwritten before any
+    later query can attend it — page accounting is untouched, which
+    tests/test_paging.py pins under cancel/expiry mid-stream.  A round
+    where no row drafted falls back to the plain ``decode_paged`` shape, so
+    both steady-state shapes are warmed and nothing retraces.
     """
+
+    #: longest context suffix the prompt-lookup drafter tries to match
+    _NGRAM_MAX = 3
 
     def __init__(
         self,
@@ -588,9 +616,21 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         *,
         prefix_cache: bool = True,
         prefix_cache_entries: int = 256,
+        spec: str = "off",
         **kwargs,
     ):
         super().__init__(engine, **kwargs)
+        if spec not in ("off", "ngram"):
+            raise ValueError(f"spec must be 'off' or 'ngram', got {spec!r}")
+        if spec != "off" and getattr(engine, "spec_k", 0) < 1:
+            raise ValueError(
+                "spec='ngram' needs an engine built with spec_k >= 1 "
+                "(the verify window compiles at (batch, spec_k+1))"
+            )
+        self._spec = spec
+        self._spec_drafted = 0  # cumulative drafted tokens (counter)
+        self._spec_accepted = 0  # cumulative accepted drafted tokens (counter)
+        self._spec_sample = jax.jit(spec_verify_draws, static_argnames=("top_k",))
         if not getattr(engine, "paged", False):
             raise ValueError(
                 "PagedContinuousBatchingScheduler needs an engine built with "
@@ -744,6 +784,126 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         self._emit_token(req.uid, first_id, 0)
         self._finish_if_done(slot_idx, finished)
 
+    # -- speculative draft / verify --------------------------------------------
+
+    def _ngram_draft(self, ctx: List[int], k: int) -> List[int]:
+        """Prompt-lookup drafting: match the longest context suffix
+        (n-gram, ``n <= _NGRAM_MAX``) against an earlier occurrence in the
+        row's own prompt+generated tokens and propose the tokens that
+        followed it (most recent occurrence wins).  Free — no second model,
+        no device work — and effective exactly when generation repeats its
+        context, the regime where speculation pays."""
+        if k <= 0 or len(ctx) < 2:
+            return []
+        for n in range(min(self._NGRAM_MAX, len(ctx) - 1), 0, -1):
+            pattern = ctx[-n:]
+            for i in range(len(ctx) - n - 1, -1, -1):
+                if ctx[i : i + n] == pattern:
+                    return ctx[i + n : i + n + k]
+        return []
+
+    def _draft_pass(self) -> Dict[int, List[int]]:
+        """Draft up to ``spec_k`` tokens per decoding row.  A row only
+        drafts within its remaining budget minus one (the round always
+        commits at least one token), so every window write — accepted or
+        rejected — stays inside the worst-case admission allocation and
+        rollback never touches the allocator."""
+        drafts: Dict[int, List[int]] = {}
+        spec_k = self.engine.spec_k
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding or not slot.request.spec:
+                continue
+            k = min(spec_k, slot.request.max_new_tokens - len(slot.tokens) - 1)
+            if k <= 0:
+                continue
+            d = self._ngram_draft(list(slot.request.prompt) + slot.tokens, k)
+            if d:
+                drafts[slot_idx] = d
+        return drafts
+
+    def _verify_round(self, drafts: Dict[int, List[int]], finished: List[Completion]) -> None:
+        """One ``(batch, spec_k+1)`` verify forward over every decoding row,
+        then the host-side accept walk.  Window row 0 carries the pending
+        token; rows ``1..k`` carry the drafts at consecutive positions.
+        Padding rows (free / prefilling / short drafts) write through the
+        trailing null column of the ``W+1``-wide tables at ``pos >=
+        cache_size``, so no live page is ever touched.  The walk commits the
+        longest accepted draft prefix plus one corrective token — greedy
+        rows by argmax match, sampled rows by rejection sampling — through
+        the same emit/finish flow as the plain path, stopping at EOS."""
+        spec_k = self.engine.spec_k
+        S = spec_k + 1
+        B = self.max_batch
+        W = self.engine.block_table_width
+        null_pos = self.engine.cache_size  # clips into the null column
+        tokens = np.zeros((B, S), np.int32)
+        positions = np.full((B, S), null_pos, np.int32)
+        tables = np.zeros((B, W + 1), np.int32)
+        draft_mat = np.zeros((B, spec_k), np.int32)
+        k_eff = np.zeros(B, np.int32)
+        uids = np.zeros(B, np.int32)
+        starts = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ps = np.ones(B, np.float32)
+        offsets = np.arange(S, dtype=np.int32)
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding:
+                continue
+            d = drafts.get(slot_idx, [])
+            tokens[slot_idx, 0] = self._tokens[slot_idx]
+            tokens[slot_idx, 1 : 1 + len(d)] = d
+            positions[slot_idx] = self._positions[slot_idx] + offsets
+            tables[slot_idx, :W] = self._tables[slot_idx]
+            draft_mat[slot_idx, : len(d)] = d
+            k_eff[slot_idx] = len(d)
+            uids[slot_idx] = slot.request.uid
+            starts[slot_idx] = len(slot.tokens)
+            temps[slot_idx] = slot.request.temperature
+            top_ps[slot_idx] = slot.request.top_p
+        logits, self._pool = self.engine.verify_paged(
+            self._ensure_pool(), tokens, positions, tables
+        )
+        accept, alt = self._spec_sample(
+            logits,
+            jnp.asarray(draft_mat),
+            self.key,
+            jnp.asarray(uids),
+            jnp.asarray(starts),
+            jnp.asarray(k_eff),
+            temperature=jnp.asarray(temps),
+            top_k=self.top_k,
+            top_p=jnp.asarray(top_ps),
+        )
+        accept = np.asarray(accept)
+        alt = np.asarray(alt)
+        drafted = accepted = 0
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding:
+                continue
+            k = int(k_eff[slot_idx])
+            a = 0
+            while a < k and accept[slot_idx, a]:
+                a += 1
+            drafted += k
+            accepted += a
+            commits = [int(t) for t in draft_mat[slot_idx, :a]]
+            commits.append(int(alt[slot_idx, a]))
+            req = slot.request
+            for tok in commits:
+                slot.tokens.append(tok)
+                slot.pos += 1
+                self._tokens[slot_idx] = tok
+                self._positions[slot_idx] = slot.pos
+                self._emit_token(req.uid, tok, len(slot.tokens) - 1)
+                self._finish_if_done(slot_idx, finished)
+                if self._slots[slot_idx] is None:
+                    break  # EOS / budget inside the window: drop the rest
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        if self.obs_registry is not None and drafted:
+            self.obs_registry.inc("spec_drafted_total", by=drafted)
+            self.obs_registry.inc("spec_accepted_total", by=accepted)
+
     # -- the budgeted round ----------------------------------------------------
 
     def step(self) -> List[Completion]:
@@ -764,20 +924,34 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             return finished  # pure-prefill round (or idle)
 
         t_decode = time.monotonic()
+        drafts = self._draft_pass() if self._spec == "ngram" else {}
+        n_drafted = sum(len(d) for d in drafts.values())
         with self.tracer.span(
-            "decode_step", step=self._step_count, active_slots=n_decoding
+            "decode_step",
+            step=self._step_count,
+            active_slots=n_decoding,
+            spec_drafted=n_drafted,
         ):
-            logits, self._pool = self.engine.decode_paged(
-                self._ensure_pool(),
-                jnp.asarray(self._tokens)[:, None],
-                jnp.asarray(self._positions)[:, None],
-                self._tables,
-            )
-            self._step_count += 1
-            masked = [
-                s if (s is not None and s.decoding) else None for s in self._slots
-            ]
-            next_tokens = self._sample_rows(logits, masked).tolist()
+            if drafts:
+                # draft→verify→accept: the walk commits straight into the
+                # slots, so there is no next_tokens loop for this branch
+                self._verify_round(drafts, finished)
+                self._step_count += 1
+                next_tokens = None
+            else:
+                # no row drafted (spec off, or nothing to look up): the
+                # plain warmed (batch, 1) decode shape
+                logits, self._pool = self.engine.decode_paged(
+                    self._ensure_pool(),
+                    jnp.asarray(self._tokens)[:, None],
+                    jnp.asarray(self._positions)[:, None],
+                    self._tables,
+                )
+                self._step_count += 1
+                masked = [
+                    s if (s is not None and s.decoding) else None for s in self._slots
+                ]
+                next_tokens = self._sample_rows(logits, masked).tolist()
         decode_s = time.monotonic() - t_decode
         self._observe("decode_step_seconds", decode_s)
         batch_fill = n_decoding / self.max_batch
@@ -793,37 +967,53 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             self.obs_registry.set_gauge("prefill_pad_share", pad_share)
             self.obs_registry.set_gauge("kv_cache_bytes", self._kv_cache_bytes)
             self.obs_registry.set_gauge("kv_bytes_per_token", self._kv_bytes_per_token)
-        for slot_idx, slot in enumerate(self._slots):
-            if slot is None or not slot.decoding:
-                continue
-            tok = next_tokens[slot_idx]
-            slot.tokens.append(tok)
-            slot.pos += 1
-            self._tokens[slot_idx] = tok
-            self._positions[slot_idx] = slot.pos
-            self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
-            self._finish_if_done(slot_idx, finished)
+            if self._spec != "off":
+                self.obs_registry.set_gauge(
+                    "spec_accept_rate",
+                    self._spec_accepted / max(self._spec_drafted, 1),
+                )
+                # by=0 materializes the counters at 0 so a spec server's
+                # /metrics always exposes them, drafts or not (and scrapers'
+                # delta logic sees the series from the start)
+                self.obs_registry.inc("spec_drafted_total", by=0)
+                self.obs_registry.inc("spec_accepted_total", by=0)
+        if next_tokens is not None:
+            for slot_idx, slot in enumerate(self._slots):
+                if slot is None or not slot.decoding:
+                    continue
+                tok = next_tokens[slot_idx]
+                slot.tokens.append(tok)
+                slot.pos += 1
+                self._tokens[slot_idx] = tok
+                self._positions[slot_idx] = slot.pos
+                self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
+                self._finish_if_done(slot_idx, finished)
         if self.metrics is not None:
             watcher = getattr(self.engine, "compile_watcher", None)
-            self.metrics.log(
-                {
-                    "serve/decode_step": self._step_count,
-                    "serve/queue_depth": len(self._pending),
-                    "serve/active_slots": self.active_slots,
-                    "serve/batch_fill": round(batch_fill, 4),
-                    "serve/prefill_stall_s": round(admit_s, 6),
-                    "serve/prefill_stall_share": round(stall_share, 4),
-                    "serve/kv_pages_used": self.allocator.used_pages,
-                    "serve/kv_pages_free": self.allocator.free_pages,
-                    "serve/prefix_cache_hit_rate": round(hit_rate, 4),
-                    "serve/prefill_pad_share": round(pad_share, 4),
-                    "serve/kv_cache_bytes": self._kv_cache_bytes,
-                    "serve/kv_bytes_per_token": round(self._kv_bytes_per_token, 4),
-                    "compile/steady_state_retraces": (
-                        watcher.steady_state_retraces if watcher is not None else 0
-                    ),
-                }
-            )
+            record = {
+                "serve/decode_step": self._step_count,
+                "serve/queue_depth": len(self._pending),
+                "serve/active_slots": self.active_slots,
+                "serve/batch_fill": round(batch_fill, 4),
+                "serve/prefill_stall_s": round(admit_s, 6),
+                "serve/prefill_stall_share": round(stall_share, 4),
+                "serve/kv_pages_used": self.allocator.used_pages,
+                "serve/kv_pages_free": self.allocator.free_pages,
+                "serve/prefix_cache_hit_rate": round(hit_rate, 4),
+                "serve/prefill_pad_share": round(pad_share, 4),
+                "serve/kv_cache_bytes": self._kv_cache_bytes,
+                "serve/kv_bytes_per_token": round(self._kv_bytes_per_token, 4),
+                "compile/steady_state_retraces": (
+                    watcher.steady_state_retraces if watcher is not None else 0
+                ),
+            }
+            if self._spec != "off":
+                record["serve/spec_drafted_total"] = self._spec_drafted
+                record["serve/spec_accepted_total"] = self._spec_accepted
+                record["serve/spec_accept_rate"] = round(
+                    self._spec_accepted / max(self._spec_drafted, 1), 4
+                )
+            self.metrics.log(record)
         return finished
 
     # -- retirement (page bookkeeping) ----------------------------------------
@@ -860,4 +1050,19 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         }
         if self.prefix_cache is not None:
             stats["prefix_cache"] = self.prefix_cache.stats()
+        if self._spec != "off":
+            stats["spec"] = self.spec_stats()
         return stats
+
+    def spec_stats(self) -> Dict[str, Any]:
+        """Cumulative speculative-decoding counters — the /healthz ``spec``
+        block and the source bench.py reads effective accept rates from."""
+        return {
+            "mode": self._spec,
+            "k": self.engine.spec_k,
+            "drafted": self._spec_drafted,
+            "accepted": self._spec_accepted,
+            "accept_rate": round(
+                self._spec_accepted / max(self._spec_drafted, 1), 4
+            ),
+        }

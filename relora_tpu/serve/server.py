@@ -136,6 +136,12 @@ def parse_generate_body(
         not isinstance(deadline_s, (int, float)) or deadline_s <= 0
     ):
         raise BadRequest('"deadline_s" must be a number > 0')
+    # per-request speculative opt-out: "spec": false skips drafting for this
+    # request on a --spec server (output distribution is identical either way);
+    # a no-op when the server runs without speculation
+    spec = payload.get("spec", True)
+    if not isinstance(spec, bool):
+        raise BadRequest('"spec" must be a boolean')
     return {
         "prompt": prompt,
         "max_new_tokens": max_new,
@@ -143,6 +149,7 @@ def parse_generate_body(
         "top_p": float(top_p),
         "stream": stream,
         "deadline_s": deadline_s,
+        "spec": spec,
     }
 
 
@@ -609,6 +616,7 @@ class GenerateServer:
                 max_new_tokens=fields["max_new_tokens"],
                 temperature=fields["temperature"],
                 top_p=fields["top_p"],
+                spec=fields["spec"],
             )
             # capacity/validity errors surface as 400 here, before admission,
             # instead of crashing the decode loop later

@@ -248,6 +248,54 @@ wait "$INT8_PID"
 grep -q "serve/kv_cache_bytes" "$WORK/int8_run/metrics.jsonl"
 grep -q "serve/kv_bytes_per_token" "$WORK/int8_run/metrics.jsonl"
 
+echo "=== 9d. speculative paged server (--spec ngram, greedy token parity vs 9b) ==="
+rm -f "$WORK/spec_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/spec_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 6 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --spec ngram --spec-k 4 \
+    --run-dir "$WORK/spec_run" &
+SPEC_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/spec_port" ] && break; sleep 0.2; done
+[ -s "$WORK/spec_port" ] || { echo "spec server never wrote its port"; kill "$SPEC_PID"; exit 1; }
+python - "$(cat "$WORK/spec_port")" "$WORK/paged_tokens.json" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+assert health["status"] == "ok", health
+spec = health["paging"]["spec"]
+assert spec["mode"] == "ngram" and spec["k"] == 4, spec
+
+def generate(prompt):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 6}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+    final = json.loads(events[-2])
+    assert final["finish_reason"] == "length" and len(final["tokens"]) == 6, final
+    return final["tokens"]
+
+# the 9b prompt again: greedy speculative decode must produce exactly the
+# tokens the non-speculative paged server produced (the parity contract)
+want = json.load(open(sys.argv[2]))
+long_prompt = [(i % 100) + 1 for i in range(40)]
+got = generate(long_prompt)
+assert got == want, f"speculative decode diverged: {got} != {want}"
+# a self-repeating prompt gives the prompt-lookup drafter material to match
+generate([3, 5, 7] * 10)
+metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+assert "relora_serve_spec_drafted_total" in metrics, metrics
+assert "relora_serve_spec_accept_rate" in metrics, metrics
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+print("spec paged HTTP OK:", got, "| spec:", health["paging"]["spec"])
+EOF
+kill -TERM "$SPEC_PID"
+wait "$SPEC_PID"
+grep -q "serve/spec_drafted_total" "$WORK/spec_run/metrics.jsonl"
+grep -q "serve/spec_accept_rate" "$WORK/spec_run/metrics.jsonl"
+
 echo "=== 10. traced run + SIGTERM flight dump (obs subsystem) ==="
 # fault injection fires a real SIGTERM at update 4; the PreemptionGuard
 # handler dumps the span flight recorder before the emergency checkpoint

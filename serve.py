@@ -118,6 +118,22 @@ def parse_args(argv=None) -> argparse.Namespace:
         action="store_true",
         help="paged: disable shared-prefix page reuse",
     )
+    p.add_argument(
+        "--spec",
+        choices=("off", "ngram"),
+        default="off",
+        help="paged: speculative decoding — 'ngram' drafts continuations by "
+        "prompt lookup over each request's own context and verifies K per "
+        "step in one forward; greedy output stays token-identical "
+        "(docs/serving.md)",
+    )
+    p.add_argument(
+        "--spec-k",
+        type=int,
+        default=4,
+        help="speculative: drafted tokens per verify step (compiled window "
+        "is spec-k+1 wide; only meaningful with --spec ngram)",
+    )
     p.add_argument("--no-scan", action="store_true", help="checkpoint was trained with scan_layers=false")
     p.add_argument(
         "--no-merge",
@@ -233,15 +249,23 @@ def main(argv=None) -> int:
         num_pages = args.num_pages or (
             args.max_batch * (cache_size // args.page_size) + 1
         )
+        if args.spec != "off" and args.spec_k < 1:
+            raise SystemExit(f"--spec {args.spec} needs --spec-k >= 1, got {args.spec_k}")
         paged_kwargs = dict(
             page_size=args.page_size,
             num_pages=num_pages,
             chunk_size=args.chunk_size,
             kv_dtype=args.kv_dtype,
+            spec_k=args.spec_k if args.spec != "off" else 0,
         )
     elif args.kv_dtype != "bf16":
         p_err = "--kv-dtype int8 requires --paged (the contiguous cache is unquantized)"
         raise SystemExit(p_err)
+    elif args.spec != "off":
+        raise SystemExit(
+            "--spec requires --paged (the verify window writes through the "
+            "paged engine's block tables)"
+        )
     mesh = None
     if args.tp > 1:
         from relora_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -282,7 +306,10 @@ def main(argv=None) -> int:
         )
         if args.paged:
             return PagedContinuousBatchingScheduler(
-                engine, prefix_cache=not args.no_prefix_cache, **common
+                engine,
+                prefix_cache=not args.no_prefix_cache,
+                spec=args.spec,
+                **common,
             )
         return ContinuousBatchingScheduler(engine, **common)
 

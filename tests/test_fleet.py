@@ -156,7 +156,7 @@ class _ScrapeTarget:
         self._thread.join(5)
 
 
-def _serve_metrics_text(finished_length=0, finished_error=0, ttfts=()):
+def _serve_metrics_text(finished_length=0, finished_error=0, ttfts=(), spec=None):
     reg = MetricsRegistry(namespace="relora_serve")
     if finished_length:
         reg.inc("requests_finished_total", ("reason", "length"), by=finished_length)
@@ -164,6 +164,10 @@ def _serve_metrics_text(finished_length=0, finished_error=0, ttfts=()):
         reg.inc("requests_finished_total", ("reason", "error"), by=finished_error)
     for v in ttfts:
         reg.observe("ttft_seconds", v)
+    if spec is not None:
+        drafted, accepted = spec
+        reg.inc("spec_drafted_total", by=drafted)
+        reg.inc("spec_accepted_total", by=accepted)
     return reg.render()
 
 
@@ -213,6 +217,31 @@ def test_collector_derives_series_and_flip_events(tmp_path):
     finally:
         a.close()
         b.close()
+
+
+def test_collector_derives_spec_accept_rate(tmp_path):
+    """Speculative counters collapse into a per-replica ``spec_accept_rate``
+    over each scrape window's counter deltas — and a window with no new
+    drafts reads 0.0 instead of dividing by zero or replaying stale state."""
+    a = _ScrapeTarget()
+    try:
+        a.metrics_text = _serve_metrics_text(finished_length=1, spec=(100, 40))
+        coll = FleetCollector(
+            lambda: {"r0": ("127.0.0.1", a.port)},
+            persist_path=str(tmp_path / "f.jsonl"),
+        )
+        coll.scrape_once(now=1000.0)
+        assert coll.store.latest("r0", "spec_accept_rate")[1] == pytest.approx(0.4)
+        # next window: +100 drafted, +50 accepted -> 0.5 for the window
+        a.metrics_text = _serve_metrics_text(finished_length=1, spec=(200, 90))
+        coll.scrape_once(now=1002.0)
+        assert coll.store.latest("r0", "spec_accept_rate")[1] == pytest.approx(0.5)
+        # idle window: counters unchanged, rate is 0, no blow-up
+        coll.scrape_once(now=1004.0)
+        assert coll.store.latest("r0", "spec_accept_rate")[1] == 0.0
+        coll.store.close()
+    finally:
+        a.close()
 
 
 def test_collector_tails_trainer_jsonl_with_torn_tail(tmp_path):

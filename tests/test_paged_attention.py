@@ -97,11 +97,54 @@ def test_fused_decode_position_zero_row():
     assert _max_err(got, want) < 1e-5
 
 
-def test_fused_decode_rejects_multi_token_query():
-    q, pk, pv, bt, pos = _pool_case(5)
-    q2 = jnp.concatenate([q, q], axis=1)  # T=2
-    with pytest.raises(ValueError, match="decode-only"):
-        paged_decode_attention(q2, pk, pv, bt, pos, interpret=True)
+def _verify_case(seed, S, *, B=2, heads=4, kv_heads=2, head_dim=8, page_size=4, W=3):
+    """A speculative verify window: S query tokens per row at consecutive
+    positions, each row staggered so the visibility frontier lands at
+    different page offsets (mid-page, page boundary, last page)."""
+    q1, pk, pv, bt, base = _pool_case(seed, B=B, heads=heads, kv_heads=kv_heads,
+                                      head_dim=head_dim, page_size=page_size, W=W)
+    q = jax.random.normal(
+        jax.random.PRNGKey(seed + 100), (B, S, heads, head_dim), jnp.float32
+    )
+    # per-token positions p..p+S-1, capped inside the table's capacity
+    pos = jnp.minimum(base + jnp.arange(S)[None, :], W * page_size - 1)
+    return q, pk, pv, bt, pos.astype(jnp.int32)
+
+
+@pytest.mark.parametrize("S", [2, 4])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_verify_small_s_matches_naive(seed, S):
+    """The speculative verify window: (B, S) queries at per-token positions
+    must match the naive gather arm — each query row's visibility mask is
+    independent, garbage beyond its own position stays masked."""
+    q, pk, pv, bt, pos = _verify_case(seed, S)
+    want = paged_cached_attention(q, pk, pv, bt, pos)
+    got = paged_decode_attention(q, pk, pv, bt, pos, interpret=True)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    assert _max_err(got, want) < 1e-5
+
+
+def test_fused_verify_small_s_int8_pool():
+    q, pk, pv, bt, pos = _verify_case(2, 4)
+    qk, k_scale = quantize_kv_page(pk)
+    qv, v_scale = quantize_kv_page(pv)
+    want = paged_cached_attention(q, qk, qv, bt, pos, k_scale=k_scale, v_scale=v_scale)
+    got = paged_decode_attention(
+        q, qk, qv, bt, pos, k_scale=k_scale, v_scale=v_scale, interpret=True
+    )
+    assert _max_err(got, want) < 1e-5
+
+
+def test_fused_verify_broadcast_positions():
+    """(B,) / (B, 1) positions broadcast over the S query tokens — every
+    token sees the same frontier, matching the naive arm fed (B, S)."""
+    q, pk, pv, bt, pos1 = _pool_case(7)
+    q = jnp.concatenate([q, q * 0.5, q * 2.0], axis=1)  # S=3
+    want = paged_cached_attention(q, pk, pv, bt, jnp.broadcast_to(pos1, (q.shape[0], 3)))
+    got_flat = paged_decode_attention(q, pk, pv, bt, pos1.reshape(-1), interpret=True)
+    got_col = paged_decode_attention(q, pk, pv, bt, pos1, interpret=True)
+    assert _max_err(got_flat, want) < 1e-5
+    assert _max_err(got_col, want) < 1e-5
 
 
 def test_fused_decode_requires_both_scales():
@@ -134,7 +177,9 @@ def test_choose_arm_regimes():
     assert choose_arm(4, 1, 2048, 32, 8, 128, 16, fused_available=False) == "naive"
     # pure causal prefill, 128-aligned -> flash
     assert choose_arm(1, 512, 512, 32, 8, 128, 16) == "flash"
-    # chunked prefill (S != S_kv, S > 1): neither pallas arm applies
+    # speculative verify window (small S) on TPU -> fused kernel
+    assert choose_arm(4, 5, 2048, 32, 8, 128, 16) == "paged_decode"
+    # chunked prefill (S beyond the verify cap): neither pallas arm applies
     assert choose_arm(1, 64, 512, 32, 8, 128, 16) == "naive"
     # allow= restricts the candidate set (the paged entry point never
     # considers flash — it is not servable from a pool)

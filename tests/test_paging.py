@@ -8,6 +8,7 @@ sampling keys stay ``(uid, token_index)``.
 """
 
 import json
+import time
 
 import jax
 import jax.numpy as jnp
@@ -161,7 +162,7 @@ class TestPrefixCache:
 # -- engine: chunked prefill and memory --------------------------------------
 
 
-def make_engines(cfg, *, cache_size=32, page_size=8, num_pages=None, chunk_size=8):
+def make_engines(cfg, *, cache_size=32, page_size=8, num_pages=None, chunk_size=8, spec_k=0):
     model = build_decode_model(cfg, cache_size=cache_size)
     base = type(model)(cfg, lora=None, dtype=jnp.float32, scan_layers=True)
     params = init_params(base, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
@@ -173,6 +174,7 @@ def make_engines(cfg, *, cache_size=32, page_size=8, num_pages=None, chunk_size=
         page_size=page_size,
         num_pages=num_pages or 3 * (cache_size // page_size) + 1,
         chunk_size=chunk_size,
+        spec_k=spec_k,
     )
     return contiguous, paged
 
@@ -416,6 +418,117 @@ def test_paged_scheduler_rejects_contiguous_engine():
     contiguous, _ = make_engines(TINY_LLAMA)
     with pytest.raises(ValueError, match="page_size"):
         PagedContinuousBatchingScheduler(contiguous, max_batch=2)
+
+
+# -- speculative rounds never move page accounting ----------------------------
+#
+# The design invariant under test: every verify-window write (accepted OR
+# rejected) lands inside the request's worst-case admission allocation or the
+# null page, so draft/verify/reject sequences are invisible to the allocator —
+# rollback is host-side bookkeeping only.  tests/test_spec.py pins output
+# parity; these pin the page accounting under mid-stream disruption.
+
+
+def spec_sched(paged):
+    return PagedContinuousBatchingScheduler(
+        paged,
+        max_batch=2,
+        eos_id=9,
+        key=jax.random.PRNGKey(42),
+        prefix_cache=False,
+        spec="ngram",
+    )
+
+
+def _step_until_drafting(sched, cap=10):
+    for _ in range(cap):
+        sched.step()
+        if sched.spec_stats()["drafted"] > 0:
+            return
+    raise AssertionError("no draft fired within the step cap")
+
+
+@pytest.mark.spec
+def test_spec_rounds_restore_allocator_exactly():
+    """Property: after a full drain with drafting rounds the free count
+    returns exactly to its pre-request value — speculation allocates and
+    frees nothing of its own."""
+    _, paged = make_engines(TINY_LLAMA, spec_k=4)
+    sched = spec_sched(paged)
+    free0 = sched.allocator.free_pages
+    rng = np.random.default_rng(3)
+    sched.run(
+        [
+            Request(uid=1, prompt=[3, 5, 7] * 4, max_new_tokens=8),
+            Request(uid=2, prompt=rng.integers(1, 256, 13).tolist(), max_new_tokens=6),
+            Request(uid=3, prompt=[2, 4] * 6, max_new_tokens=7),
+        ]
+    )
+    assert sched.spec_stats()["drafted"] > 0
+    assert sched.allocator.free_pages == free0
+    assert sched.allocator.used_pages == 0
+
+
+@pytest.mark.spec
+@pytest.mark.slow
+def test_cancel_mid_verify_frees_only_victim_pages():
+    """Cancelling a request between verify rounds frees exactly its own
+    reservation; the surviving slot's pages stay live and its greedy output
+    still matches a solo non-speculative run."""
+    _, paged = make_engines(TINY_LLAMA, spec_k=4)
+    sched = spec_sched(paged)
+    free0 = sched.allocator.free_pages
+    survivor_prompt = [2, 4] * 5
+    sched.submit(Request(uid=1, prompt=[3, 5, 7] * 4, max_new_tokens=10))
+    sched.submit(Request(uid=2, prompt=survivor_prompt, max_new_tokens=10))
+    _step_until_drafting(sched)
+    assert sched.active_slots == 2
+    completion = sched.cancel(1)
+    assert completion.finish_reason == "cancelled"
+    # the victim's full worst-case reservation came back, nothing else
+    assert sched.allocator.free_pages == free0 - pages_needed(
+        len(survivor_prompt) + 10, paged.page_size
+    )
+    done = {}
+    while sched.has_work():
+        for c in sched.step():
+            done[c.uid] = c
+    assert sched.allocator.free_pages == free0  # pinned: no page leaked
+    reference = PagedContinuousBatchingScheduler(
+        paged, max_batch=2, eos_id=9, key=jax.random.PRNGKey(42), prefix_cache=False
+    )
+    want = reference.run(
+        [Request(uid=2, prompt=survivor_prompt, max_new_tokens=10)]
+    )[2].tokens
+    assert done[2].tokens == want  # live pages untouched by the cancel
+
+
+@pytest.mark.spec
+@pytest.mark.slow
+def test_deadline_expiry_mid_spec_restores_free_count():
+    """A deadline expiring between verify rounds retires the slot with its
+    partial output and returns its pages — the draft/verify machinery holds
+    no page state that could leak across the expiry."""
+    _, paged = make_engines(TINY_LLAMA, spec_k=4)
+    sched = spec_sched(paged)
+    free0 = sched.allocator.free_pages
+    sched.submit(
+        Request(uid=1, prompt=[3, 5, 7] * 4, max_new_tokens=10),
+        deadline=time.monotonic() + 60.0,
+    )
+    sched.submit(Request(uid=2, prompt=[2, 4] * 5, max_new_tokens=8))
+    _step_until_drafting(sched)
+    # yank the running deadline into the past: the next round expires it
+    slot = next(s for s in sched._slots if s is not None and s.request.uid == 1)
+    slot.deadline = time.monotonic() - 1.0
+    done = {}
+    while sched.has_work():
+        for c in sched.step():
+            done[c.uid] = c
+    assert done[1].finish_reason == "timeout" and done[1].tokens
+    assert done[2].finish_reason in ("eos", "length")
+    assert sched.allocator.free_pages == free0
+    assert sched.allocator.used_pages == 0
 
 
 # -- int8 KV pool: the quantization dial ---------------------------------------

@@ -227,6 +227,15 @@ def test_parse_generate_body_validation():
     assert fields["top_p"] == 0.9
     assert fields["stream"] is True
     assert fields["deadline_s"] is None
+    assert fields["spec"] is True  # per-request opt-out defaults to on
+
+    opted_out = parse_generate_body(
+        json.dumps({"prompt": [1], "spec": False}).encode(),
+        default_max_new_tokens=8,
+        default_temperature=0.0,
+        default_top_p=1.0,
+    )
+    assert opted_out["spec"] is False
 
     bad = [
         b"not json",
@@ -240,6 +249,7 @@ def test_parse_generate_body_validation():
         json.dumps({"prompt": [1], "top_p": 1.5}).encode(),
         json.dumps({"prompt": [1], "stream": "yes"}).encode(),
         json.dumps({"prompt": [1], "deadline_s": -1}).encode(),
+        json.dumps({"prompt": [1], "spec": "on"}).encode(),
     ]
     for body in bad:
         with pytest.raises(BadRequest):

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Six rules, each skipped gracefully when its input files are absent:
+Seven rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -29,6 +29,11 @@ Six rules, each skipped gracefully when its input files are absent:
    artifact was recorded in interpreter mode (``detail.is_interpret`` —
    off-TPU the pallas arm runs the pallas interpreter, a correctness
    record whose timings carry no performance signal).
+7. **speculative decoding** (``BENCH_http.json`` ``detail.spec_runs``): on
+   TPU every ngram sweep level must hold its accept rate at or above the
+   committed ``spec_accept_rate_floor`` and its effective tok/s within
+   ``--tolerance`` of the non-speculative "off" level.  Skipped off-TPU —
+   CPU timings and random-token bench prompts carry no speculation signal.
 
 Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
 ``--warn-only`` reports failures but exits 0 — CI uses it off-TPU where the
@@ -240,6 +245,54 @@ def check_attn(bench_dir: str, tolerance: float) -> List[str]:
     return failures
 
 
+def check_spec(
+    bench_dir: str, baselines: Optional[Dict[str, Any]], tolerance: float
+) -> List[str]:
+    """Speculative-decoding rules over ``detail.spec_runs`` in BENCH_http.json
+    (present only for paged ``--mode serve_load`` runs with the spec sweep):
+
+    - every ngram level that drafted anything must hold its cumulative accept
+      rate at or above the committed ``spec_accept_rate_floor`` — a collapse
+      here means the draft source or the verify/accept walk broke, not noise;
+    - each ngram level's effective tok/s must not fall below the "off" level
+      by more than ``tolerance`` — speculation that loses throughput to its
+      own verify overhead is a regression, the roofline said it should win.
+
+    Skipped entirely off-TPU (like ``check_attn``): CPU timings carry no
+    throughput signal, and random-token bench prompts make acceptance a
+    property of the model's repetition loops, not the feature.
+    """
+    doc = _load(os.path.join(bench_dir, "BENCH_http.json"))
+    detail = (doc or {}).get("detail") or {}
+    spec_runs = detail.get("spec_runs") or {}
+    if not spec_runs:
+        return []
+    if "cpu" in str(detail.get("device", "")).lower():
+        return []  # off-TPU: no throughput signal, acceptance is prompt noise
+    floor = float((baselines or {}).get("spec_accept_rate_floor", 0.0))
+    off_tok_s = (spec_runs.get("off") or {}).get("effective_tokens_per_s")
+    failures = []
+    for level, run in spec_runs.items():
+        if run.get("mode") == "off":
+            continue
+        drafted = run.get("drafted", 0)
+        rate = run.get("accept_rate")
+        if drafted and isinstance(rate, (int, float)) and rate < floor:
+            failures.append(
+                f"spec {level}: accept rate {rate:.3f} below floor {floor:.3f} "
+                f"({run.get('accepted', 0)}/{drafted} drafted tokens accepted)"
+            )
+        got = run.get("effective_tokens_per_s")
+        if isinstance(got, (int, float)) and isinstance(off_tok_s, (int, float)):
+            if got < off_tok_s * (1.0 - tolerance):
+                failures.append(
+                    f"spec {level}: effective {got:,.1f} tok/s is "
+                    f"{(1 - got / off_tok_s) * 100:.0f}% below non-speculative "
+                    f"{off_tok_s:,.1f} tok/s (tolerance {tolerance * 100:.0f}%)"
+                )
+    return failures
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--check", action="store_true", help="run the gate (the only mode)")
@@ -287,6 +340,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_router(args.dir, baselines)
         + check_obs(args.dir)
         + check_attn(args.dir, args.tolerance)
+        + check_spec(args.dir, baselines, args.tolerance)
     )
 
     rounds = real_rounds(args.dir)

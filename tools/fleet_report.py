@@ -43,6 +43,7 @@ _COMPARE_COLUMNS = (
     ("tpot_p95_ms", "relora_serve_tpot_seconds_p95", 1e3, "{:.2f}"),
     ("err_rate", "error_rate", 1.0, "{:.3f}"),
     ("tok_per_s", "relora_serve_tokens_generated_total_per_s", 1.0, "{:.1f}"),
+    ("spec_acc", "spec_accept_rate", 1.0, "{:.3f}"),
 )
 
 _TIMELINE_KINDS = (

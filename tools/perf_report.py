@@ -212,6 +212,16 @@ def print_serving(records: List[Dict[str, Any]], out) -> None:
             f"  kv pool         {fmt_bytes(pool)} resident"
             f"  ({fmt_bytes(per_tok)}/token across layers)\n"
         )
+    # speculative decoding (--spec runs only): counters are cumulative, so
+    # the last record carries the run totals
+    spec_steps = [r for r in paged_steps if "serve/spec_drafted_total" in r]
+    if spec_steps:
+        last = spec_steps[-1]
+        out.write(
+            f"  speculative     accept rate {last.get('serve/spec_accept_rate', 0.0) * 100:5.1f}%"
+            f"  ({last.get('serve/spec_accepted_total', 0)}/"
+            f"{last.get('serve/spec_drafted_total', 0)} drafted tokens accepted)\n"
+        )
 
 
 def print_phases(trace_path: str, out) -> None:
