@@ -409,6 +409,14 @@ def serve_load_main(router: bool = False) -> None:
     # prefill AND prefix-cache reuse under load
     long_prompt_len = int(os.environ.get("BENCH_HTTP_LONG_PROMPT_LEN", str(4 * prompt_len)))
     long_share = float(os.environ.get("BENCH_HTTP_LONG_SHARE", "0.25"))
+    # multi-tenant sweep: tok/s + tail latency vs how many distinct adapters
+    # the same offered load touches (0 = lora-enabled engine, all-base
+    # requests, isolating the grouped-path overhead). "" disables the sweep.
+    adapter_counts = [
+        int(v)
+        for v in os.environ.get("BENCH_HTTP_ADAPTER_COUNTS", "0,2,4").split(",")
+        if v.strip()
+    ]
 
     import jax
     import jax.numpy as jnp
@@ -474,12 +482,19 @@ def serve_load_main(router: bool = False) -> None:
             return long_prompts[(i // long_every) % len(long_prompts)]
         return prompts[i % len(prompts)]
 
+    # the adapter sweep swaps this per run; None = no "adapter" body field
+    adapter_for = {"fn": None}
+
     async def one_request(i: int, port: int = 0) -> dict:
         payload = {
             "prompt": pick_prompt(i),
             "max_new_tokens": new_tokens,
             "stream": True,
         }
+        if adapter_for["fn"] is not None:
+            name = adapter_for["fn"](i)
+            if name is not None:
+                payload["adapter"] = name
         body = json.dumps(payload).encode()
         t_send = time.perf_counter()
         reader, writer = await asyncio.open_connection("127.0.0.1", port or server.port)
@@ -822,6 +837,68 @@ def serve_load_main(router: bool = False) -> None:
                 kv_dtypes[0], spec=mode, spec_k=int(kstr or "4")
             )
             spec_runs[level] = spec_entry(asyncio.run(bench()), scheduler.spec_stats())
+    # -- multi-tenant adapter sweep -------------------------------------------
+    # Each count rebuilds the stack with a lora-enabled engine, an
+    # AdapterRegistry preloaded with `count` tenants (distinct factor
+    # scalings of the same shapes — perf, not quality), and re-drives the
+    # load levels with requests round-robining over the tenants.
+
+    def build_adapter_stack(num_adapters: int):
+        from relora_tpu.core.relora import LoraSpec
+        from relora_tpu.serve.adapters import AdapterRegistry, extract_lora_factors
+
+        lspec = LoraSpec(r=int(os.environ.get("BENCH_HTTP_ADAPTER_RANK", "8")), alpha=16)
+        slots = max(2, num_adapters + 1)
+        lmodel = build_decode_model(cfg, cache_size=cache_size, lora=lspec)
+        lparams = init_params(lmodel, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+        if paged:
+            num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
+            eng = InferenceEngine(
+                cfg, lparams, cache_size=cache_size,
+                page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
+                lora=lspec, adapter_slots=slots,
+            )
+            eng.warmup(max_batch)
+        else:
+            eng = InferenceEngine(
+                cfg, lparams, cache_size=cache_size, lora=lspec, adapter_slots=slots
+            )
+            buckets = sorted({prompt_len} | ({long_prompt_len} if long_share > 0 else set()))
+            eng.warmup(max_batch, prompt_buckets=tuple(buckets))
+        # preload after warmup: warmup's compile-priming zero-write targets
+        # the last slot and would clobber a tenant loaded first
+        reg = AdapterRegistry(None, slots, expected_r=lspec.r, writer=eng.adapter_writer())
+        base_factors = extract_lora_factors(lparams)
+        for g in range(num_adapters):
+            factors = jax.tree_util.tree_map(
+                lambda t, _g=g: t * (0.5 + 0.25 * _g), base_factors
+            )
+            reg.preload(f"t{g}", factors, lspec.scale)
+        sched_cls = PagedContinuousBatchingScheduler if paged else ContinuousBatchingScheduler
+        sched = sched_cls(eng, max_batch=max_batch, adapter_registry=reg)
+        return eng, sched, GenerateServer(sched, port=0, max_queue=max_queue), reg
+
+    adapter_runs = {}
+    for count in adapter_counts:
+        engine, scheduler, server, adapter_registry = build_adapter_stack(count)
+        adapter_for["fn"] = (
+            (lambda i, _c=count: f"t{i % _c}") if count else (lambda i: None)
+        )
+        run_rows = asyncio.run(bench())
+        adapter_for["fn"] = None
+        pk = max(run_rows, key=lambda r: r["throughput_tokens_per_s"])
+        reg_stats = adapter_registry.stats()
+        adapter_runs[str(count)] = {
+            "adapters": count,
+            "adapter_slots": adapter_registry.num_slots,
+            "peak_throughput_tokens_per_s": pk["throughput_tokens_per_s"],
+            "ttft_p95_ms_at_peak": pk["ttft_p95_ms"],
+            "tpot_p95_ms_at_peak": pk["tpot_p95_ms"],
+            "slot_hit_rate": reg_stats["hit_rate"],
+            "evictions_total": reg_stats["evictions_total"],
+            "levels": run_rows,
+        }
+
     router_detail = router_phase() if router else None
     peak = max(rows, key=lambda r: r["throughput_tokens_per_s"])
     saturated = max(rows, key=lambda r: r["reject_rate"])
@@ -856,6 +933,7 @@ def serve_load_main(router: bool = False) -> None:
                 else {}
             ),
             "reject_rate_at_saturation": saturated["reject_rate"],
+            "adapter_runs": adapter_runs,
             "levels": rows,
             **({"router": router_detail} if router_detail is not None else {}),
         },
@@ -878,7 +956,13 @@ def lora_kernel_main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from relora_tpu.ops.lora_dispatch import choose_arm, lora_matmul, plan_blocks
+    from relora_tpu.ops.lora_dispatch import (
+        choose_arm,
+        choose_grouped_arm,
+        lora_matmul,
+        lora_matmul_grouped,
+        plan_blocks,
+    )
 
     on_tpu = jax.default_backend() == "tpu"
     # CPU-interpret fused arms are slow: default to small buckets off-TPU.
@@ -930,6 +1014,50 @@ def lora_kernel_main() -> None:
             )
             buckets.append(row)
 
+    # multi-tenant grouped buckets: the three grouped arms per
+    # (B, K, N, r, distinct-adapters).  B rows round-robin over G adapter
+    # slots; off-TPU the scalar-prefetch kernel is the interpreter so the
+    # default shapes stay small (the dispatch model routes to "gathered"
+    # there anyway — model_choice records it).
+    group_counts = [
+        int(v) for v in os.environ.get("BENCH_LORA_GROUPS", "1,4").split(",") if v.strip()
+    ]
+    grouped_default = "8:2048:2048,256:2048:2048" if on_tpu else "8:512:512,32:512:512"
+    grouped_shapes = [
+        tuple(int(v) for v in bucket.split(":"))
+        for bucket in os.environ.get("BENCH_LORA_GROUP_SHAPES", grouped_default).split(",")
+    ]
+    nbytes = jnp.dtype(dtype).itemsize
+    grouped_buckets = []
+    for B, K, N in grouped_shapes:
+        for r in ranks:
+            for G in group_counts:
+                S = max(G, 1)
+                ks = jax.random.split(jax.random.fold_in(key, B * 977 + r * 31 + G), 4)
+                x = jax.random.normal(ks[0], (B, K), dtype)
+                w = jax.random.normal(ks[1], (K, N), dtype)
+                a_stack = jax.random.normal(ks[2], (S, K, r), dtype) * 0.01
+                b_stack = jax.random.normal(ks[3], (S, r, N), dtype) * 0.01
+                scale_stack = jnp.full((S,), 0.25, dtype)
+                idx = jnp.arange(B, dtype=jnp.int32) % S
+                row = {"B": B, "K": K, "N": N, "r": r, "distinct_adapters": G}
+                for arm in ("grouped", "gathered", "looped"):
+                    fn = jax.jit(
+                        lambda x, w, a, b, s, i, _arm=arm: lora_matmul_grouped(
+                            x, w, a, b, s, i, arm=_arm
+                        )
+                    )
+                    row[f"{arm}_ms"] = round(
+                        time_arm(fn, x, w, a_stack, b_stack, scale_stack, idx) * 1e3, 4
+                    )
+                row["model_choice"] = choose_grouped_arm(
+                    B, K, N, r, G, nbytes, nbytes, grouped_available=on_tpu
+                )
+                row["measured_best"] = min(
+                    ("grouped", "gathered", "looped"), key=lambda arm: row[f"{arm}_ms"]
+                )
+                grouped_buckets.append(row)
+
     top = buckets[-1]
     result = {
         "metric": f"fused LoRA kernel speedup vs unfused "
@@ -943,6 +1071,7 @@ def lora_kernel_main() -> None:
             "dtype": dtype_name,
             "iters": iters,
             "buckets": buckets,
+            "grouped_buckets": grouped_buckets,
         },
     }
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_lora.json")

@@ -59,6 +59,15 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "InferenceEngine.prefill_chunk",  # paged: once per round
         "InferenceEngine.decode_paged",  # paged: every decode step
         "InferenceEngine.init_pool",
+        "InferenceEngine._row_idx",  # adapter routing, once per prefill/decode
+    ],
+    # multi-tenant registry: acquire/release run inside the schedulers' admit
+    # and retire passes, once per request per round.  Loads and evictions do
+    # intentional device writes at swap cadence in _load_into — a separate
+    # non-hot helper, following the sanctioned pattern above.
+    "relora_tpu/serve/adapters.py": [
+        "AdapterRegistry.acquire",
+        "AdapterRegistry.release",
     ],
     "relora_tpu/serve/sampling.py": [""],  # jitted per decode step
     # serve/paging.py carries the HOT_MARKER comment instead of an entry
@@ -70,6 +79,8 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "PagedContinuousBatchingScheduler.step",  # one budgeted round
         "PagedContinuousBatchingScheduler._admit_pass",  # per round
         "PagedContinuousBatchingScheduler._prefill_pass",  # per round
+        "ContinuousBatchingScheduler._acquire_adapter",  # per admitted request
+        "ContinuousBatchingScheduler._release_adapter",  # per retired request
     ],
     # the HTTP front-end's model thread calls scheduler.step() in a loop; a
     # stray sync there stalls every in-flight stream.  The asyncio handlers

@@ -73,6 +73,13 @@ class LoraSpec:
     # W + scale·A@B as amortized (it decides decode-shaped calls toward the
     # merged arm).  Never set in training — W changes every update.
     weights_static: bool = False
+    # Multi-tenant serving (serve/adapters.py): > 0 stacks every LoRA factor
+    # as (num_slots, in, r)/(num_slots, r, out) HBM slabs and routes the
+    # forward through the grouped kernel with a per-row adapter_idx.  Slot 0
+    # is the identity (base-model) adapter: lora_b zero-init makes every
+    # unloaded slot a no-op branch.  0 (the default, and what every training
+    # sidecar on disk says implicitly) keeps the single-adapter layout.
+    num_slots: int = 0
 
     def __post_init__(self):
         # validate HERE (not just TrainingConfig): bench.py/bench_sweep/
@@ -85,6 +92,18 @@ class LoraSpec:
             raise ValueError("base_dtype applies to the unquantized base; drop it or quantize")
         if self.fused not in (True, False, "auto"):
             raise ValueError(f"fused must be True, False or 'auto', got {self.fused!r}")
+        if self.num_slots < 0:
+            raise ValueError(f"num_slots must be >= 0, got {self.num_slots}")
+        if self.num_slots > 0 and self.trainable_scaling:
+            raise ValueError(
+                "num_slots > 0 is a serving-only layout; trainable_scaling has no "
+                "stacked equivalent (per-slot scales come from each adapter's sidecar)"
+            )
+        if self.num_slots > 0 and self.quantize:
+            raise ValueError(
+                "num_slots > 0 requires a dense base (the grouped kernel does not "
+                "read quantized bases); drop quantize for multi-tenant serving"
+            )
 
     @property
     def scale(self) -> float:

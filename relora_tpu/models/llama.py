@@ -254,6 +254,7 @@ class LlamaAttention(nn.Module):
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
         block_tables: Optional[jax.Array] = None,
+        adapter_idx: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -261,9 +262,9 @@ class LlamaAttention(nn.Module):
         dense = functools.partial(
             LoRALinear, lora=self.lora, dtype=self.dtype, use_bias=False
         )
-        q = dense(h, kernel_axes=("embed", "qkv"), name="q_proj")(x, deterministic)
-        k = dense(n_kv * hd, kernel_axes=("embed", "kv"), name="k_proj")(x, deterministic)
-        v = dense(n_kv * hd, kernel_axes=("embed", "kv"), name="v_proj")(x, deterministic)
+        q = dense(h, kernel_axes=("embed", "qkv"), name="q_proj")(x, deterministic, adapter_idx)
+        k = dense(n_kv * hd, kernel_axes=("embed", "kv"), name="k_proj")(x, deterministic, adapter_idx)
+        v = dense(n_kv * hd, kernel_axes=("embed", "kv"), name="v_proj")(x, deterministic, adapter_idx)
 
         B, S = x.shape[:2]
         q = q.reshape(B, S, n, hd)
@@ -281,7 +282,7 @@ class LlamaAttention(nn.Module):
         else:
             out = dot_product_attention(q, k, v, causal=True, impl=self.attention_impl)
         out = out.reshape(B, S, h)
-        return dense(h, kernel_axes=("qkv", "embed"), name="o_proj")(out, deterministic)
+        return dense(h, kernel_axes=("qkv", "embed"), name="o_proj")(out, deterministic, adapter_idx)
 
 
 class LlamaMLP(nn.Module):
@@ -292,22 +293,25 @@ class LlamaMLP(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, deterministic: bool = True,
+        adapter_idx: Optional[jax.Array] = None,
+    ) -> jax.Array:
         cfg = self.config
         dense = functools.partial(
             LoRALinear, lora=self.lora, dtype=self.dtype, use_bias=False
         )
-        gate = dense(cfg.intermediate_size, kernel_axes=("embed", "mlp"), name="gate_proj")(x, deterministic)
-        up = dense(cfg.intermediate_size, kernel_axes=("embed", "mlp"), name="up_proj")(x, deterministic)
+        gate = dense(cfg.intermediate_size, kernel_axes=("embed", "mlp"), name="gate_proj")(x, deterministic, adapter_idx)
+        up = dense(cfg.intermediate_size, kernel_axes=("embed", "mlp"), name="up_proj")(x, deterministic, adapter_idx)
         fused = nn.silu(gate) * up
-        return dense(cfg.hidden_size, kernel_axes=("mlp", "embed"), name="down_proj")(fused, deterministic)
+        return dense(cfg.hidden_size, kernel_axes=("mlp", "embed"), name="down_proj")(fused, deterministic, adapter_idx)
 
 
 class LlamaDecoderLayer(nn.Module):
     """Pre-norm block (parity: modeling_llama.py:243-308).
 
     Signature is scan-compatible:
-    ``(x, cos, sin, positions, det, block_tables) -> (x, None)``.
+    ``(x, cos, sin, positions, det, block_tables, adapter_idx) -> (x, None)``.
     """
 
     config: ModelConfig
@@ -321,7 +325,7 @@ class LlamaDecoderLayer(nn.Module):
     kv_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None):
         cfg = self.config
         a = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         a = LlamaAttention(
@@ -329,10 +333,10 @@ class LlamaDecoderLayer(nn.Module):
             self.decode, self.cache_size, self.page_size, self.num_pages,
             self.kv_dtype,
             name="self_attn"
-        )(a, cos, sin, positions, deterministic, block_tables)
+        )(a, cos, sin, positions, deterministic, block_tables, adapter_idx)
         x = x + a
         m = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="post_attention_layernorm")(x)
-        m = LlamaMLP(cfg, self.lora, self.dtype, name="mlp")(m, deterministic)
+        m = LlamaMLP(cfg, self.lora, self.dtype, name="mlp")(m, deterministic, adapter_idx)
         return x + m, None
 
 
@@ -343,6 +347,7 @@ def decoder_stack(
     deterministic: bool,
     input_len: int,
     block_tables: Optional[jax.Array] = None,
+    adapter_idx: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Shared decoder body: rotary tables + (scanned or unrolled) layers +
     final norm.  Called from inside a parent's @nn.compact, so submodules
@@ -396,17 +401,17 @@ def decoder_stack(
             block,
             variable_axes=variable_axes,
             split_rngs={"params": True, "dropout": True},
-            in_axes=(nn.broadcast,) * 5,
+            in_axes=(nn.broadcast,) * 6,
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
         x, _ = scanned(**layer_kwargs, name="layers")(
-            x, cos, sin, positions, deterministic, block_tables
+            x, cos, sin, positions, deterministic, block_tables, adapter_idx
         )
     else:
         for i in range(cfg.num_hidden_layers):
             x, _ = block(**layer_kwargs, name=f"layers_{i}")(
-                x, cos, sin, positions, deterministic, block_tables
+                x, cos, sin, positions, deterministic, block_tables, adapter_idx
             )
     return RMSNorm(eps=cfg.rms_norm_eps, dtype=module.dtype, name="norm")(x)
 
@@ -463,10 +468,12 @@ class LlamaForCausalLM(nn.Module):
         deterministic: bool = True,
         return_hidden: bool = False,
         block_tables: Optional[jax.Array] = None,
+        adapter_idx: Optional[jax.Array] = None,
     ) -> jax.Array:
         x = token_embed(self, input_ids)
         x = decoder_stack(
-            self, x, positions, deterministic, input_ids.shape[1], block_tables
+            self, x, positions, deterministic, input_ids.shape[1], block_tables,
+            adapter_idx,
         )
         if return_hidden:
             # chunked-CE path: the caller streams the lm_head projection

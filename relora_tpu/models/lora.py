@@ -72,8 +72,17 @@ class LoRALinear(nn.Module):
         super().__post_init__()
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        deterministic: bool = True,
+        adapter_idx: Optional[jax.Array] = None,
+    ) -> jax.Array:
         in_features = x.shape[-1]
+        if self.lora is not None and self.lora.num_slots > 0:
+            # multi-tenant serving layout: factors stacked (num_slots, ...),
+            # each activation row routed to its slot by adapter_idx
+            return self._grouped(x, in_features, adapter_idx)
         if self.lora is not None and self.lora.lora_only:
             # pure-LoRA layer: no base weight, no bias (relora.py:209-211)
             return self._lora_branch(x, in_features, deterministic)
@@ -217,6 +226,71 @@ class LoRALinear(nn.Module):
             arm="fused" if self.lora.fused is True else "auto",
             dtype=self.dtype,
             weights_static=self.lora.weights_static,
+        )
+        if self.use_bias:
+            y = y + self._bias_param().astype(self.dtype)
+        return y
+
+    def _grouped(
+        self, x: jax.Array, in_features: int, adapter_idx: Optional[jax.Array]
+    ) -> jax.Array:
+        """Multi-tenant composite: stacked (num_slots, ...) factor leaves and
+        the per-row slot map through ops/lora_dispatch.lora_matmul_grouped.
+
+        Every slot zero-inits (lora_b = 0 ⇒ identity branch), so slot 0 is
+        the base-model adapter by construction and unloaded slots are inert;
+        serve/adapters.py overwrites slots in place as tenants load/evict —
+        shapes are static, swaps are pure data movement.  ``adapter_idx`` may
+        be per-row (M,) or per-batch (B,) (repeated across the row dim);
+        ``None`` routes everything to slot 0.
+        """
+        from relora_tpu.ops.lora_dispatch import lora_matmul_grouped
+
+        spec = self.lora
+        base = jax.lax.stop_gradient(
+            self._dense_kernel(in_features).astype(self.dtype)
+        )
+        a_stack = self.param(
+            "lora_a",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, self.kernel_axes[0], "lora")
+            ),
+            (spec.num_slots, in_features, spec.r),
+            self.param_dtype,
+        )
+        b_stack = self.param(
+            "lora_b",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, "lora", self.kernel_axes[1])
+            ),
+            (spec.num_slots, spec.r, self.features),
+            self.param_dtype,
+        )
+        # per-slot scale (each adapter's sidecar may carry its own alpha)
+        s_stack = self.param(
+            "lora_s",
+            lambda key, shape, dtype: jnp.full(shape, spec.scale, dtype),
+            (spec.num_slots,),
+            jnp.float32,
+        )
+        rows = 1
+        for d in x.shape[:-1]:
+            rows *= d
+        if adapter_idx is None:
+            idx = jnp.zeros((rows,), jnp.int32)
+        else:
+            idx = adapter_idx.reshape(-1).astype(jnp.int32)
+            if idx.shape[0] != rows:
+                idx = jnp.repeat(idx, rows // idx.shape[0])
+        y = lora_matmul_grouped(
+            x.astype(self.dtype),
+            base,
+            a_stack.astype(self.dtype),
+            b_stack.astype(self.dtype),
+            s_stack,
+            idx,
+            arm="auto",
+            dtype=self.dtype,
         )
         if self.use_bias:
             y = y + self._bias_param().astype(self.dtype)

@@ -77,7 +77,7 @@ class NeoXAttention(nn.Module):
     kv_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None):
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
         rot = cfg.rotary_dim
@@ -89,7 +89,7 @@ class NeoXAttention(nn.Module):
             dtype=self.dtype,
             kernel_axes=("embed", "qkv"),
             name="query_key_value",
-        )(x, deterministic)
+        )(x, deterministic, adapter_idx)
         B, S = x.shape[:2]
         # HF NeoX fused layout: out dim is (heads, 3 * head_dim) interleaved
         qkv = qkv.reshape(B, S, n, 3 * hd)
@@ -114,7 +114,7 @@ class NeoXAttention(nn.Module):
             dtype=self.dtype,
             kernel_axes=("qkv", "embed"),
             name="dense",
-        )(out, deterministic)
+        )(out, deterministic, adapter_idx)
 
 
 class NeoXMLP(nn.Module):
@@ -123,17 +123,17 @@ class NeoXMLP(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, adapter_idx=None):
         cfg = self.config
         dense = functools.partial(
             LoRALinear, use_bias=True, lora=self.lora, dtype=self.dtype
         )
         y = dense(cfg.intermediate_size, kernel_axes=("embed", "mlp"), name="dense_h_to_4h")(
-            x, deterministic
+            x, deterministic, adapter_idx
         )
         y = nn.gelu(y, approximate=False)
         return dense(cfg.hidden_size, kernel_axes=("mlp", "embed"), name="dense_4h_to_h")(
-            y, deterministic
+            y, deterministic, adapter_idx
         )
 
 
@@ -152,7 +152,7 @@ class NeoXLayer(nn.Module):
     kv_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None):
         cfg = self.config
         attn_in = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         attn_out = NeoXAttention(
@@ -160,11 +160,11 @@ class NeoXLayer(nn.Module):
             self.decode, self.cache_size, self.page_size, self.num_pages,
             self.kv_dtype,
             name="attention"
-        )(attn_in, cos, sin, positions, deterministic, block_tables)
+        )(attn_in, cos, sin, positions, deterministic, block_tables, adapter_idx)
         mlp_in = LayerNorm(
             eps=cfg.layer_norm_eps, dtype=self.dtype, name="post_attention_layernorm"
         )(x if cfg.use_parallel_residual else x + attn_out)
-        mlp_out = NeoXMLP(cfg, self.lora, self.dtype, name="mlp")(mlp_in, deterministic)
+        mlp_out = NeoXMLP(cfg, self.lora, self.dtype, name="mlp")(mlp_in, deterministic, adapter_idx)
         if cfg.use_parallel_residual:
             # x + attn(ln1(x)) + mlp(ln2(x))
             return x + attn_out + mlp_out, None
@@ -201,6 +201,7 @@ class GPTNeoXForCausalLM(nn.Module):
         deterministic: bool = True,
         return_hidden: bool = False,
         block_tables: Optional[jax.Array] = None,
+        adapter_idx: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         x = nn.Embed(
@@ -253,17 +254,17 @@ class GPTNeoXForCausalLM(nn.Module):
                 block,
                 variable_axes=variable_axes,
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast,) * 5,
+                in_axes=(nn.broadcast,) * 6,
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
             x, _ = scanned(**layer_kwargs, name="layers")(
-                x, cos, sin, positions, deterministic, block_tables
+                x, cos, sin, positions, deterministic, block_tables, adapter_idx
             )
         else:
             for i in range(cfg.num_hidden_layers):
                 x, _ = block(**layer_kwargs, name=f"layers_{i}")(
-                    x, cos, sin, positions, deterministic, block_tables
+                    x, cos, sin, positions, deterministic, block_tables, adapter_idx
                 )
 
         x = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)

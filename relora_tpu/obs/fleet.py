@@ -430,6 +430,7 @@ class FleetCollector:
         finished_bad = 0.0
         spec_drafted = None
         spec_accepted = None
+        evict_delta = None
         for name, value in flat.items():
             values[name] = value
             if name.endswith("_total") or "_total." in name:
@@ -449,6 +450,11 @@ class FleetCollector:
                     spec_drafted = max(0.0, value - prev[1]) if prev is not None else value
                 elif name.endswith("spec_accepted_total"):
                     spec_accepted = max(0.0, value - prev[1]) if prev is not None else value
+                # adapter churn is a delta, not a lifetime total: the first
+                # scrape contributes 0 so a report rebuilt from disk does not
+                # see the whole run's evictions as one giant round
+                elif name.endswith("adapter_evictions_total"):
+                    evict_delta = max(0.0, value - prev[1]) if prev is not None else 0.0
             if "group_" in name and name.endswith("_healthy"):
                 prev_g = self._last_gauges.get((source, name))
                 if prev_g is not None and prev_g != value:
@@ -464,6 +470,20 @@ class FleetCollector:
             values["spec_accept_rate"] = (
                 (spec_accepted or 0.0) / spec_drafted if spec_drafted > 0 else 0.0
             )
+        if evict_delta is not None:
+            # per-replica adapter churn: evictions this round.  A round that
+            # turns over the whole slot pool means tenants are thrashing each
+            # other's slots — the operations.md triage is "raise
+            # --adapter-slots", so surface it on the fleet timeline.
+            values["adapter_churn"] = evict_delta
+            slots_used = next(
+                (v for k, v in flat.items() if k.endswith("adapter_slots_used")), None
+            )
+            if evict_delta >= max(2.0, slots_used or 0.0):
+                self.store.add_event(
+                    "adapter_thrash", source, t=now,
+                    evictions=evict_delta, slots_used=slots_used,
+                )
         for name, h in hists.items():
             values[f"{name}_p50"] = histogram_quantile(h["buckets"], 0.50)
             values[f"{name}_p95"] = histogram_quantile(h["buckets"], 0.95)

@@ -91,6 +91,13 @@ class MetricsRegistry:
                 hist = self._hists[name] = Histogram()
             hist.observe(value)
 
+    def materialize_histogram(self, name: str) -> None:
+        """Create ``name`` with zero observations so it renders (all-zero
+        buckets) before the first sample — scrapers and alert rules need the
+        series to exist from t0, not from the first slow event."""
+        with self._lock:
+            self._hists.setdefault(name, Histogram())
+
     def counter_value(self, name: str, label: Optional[Tuple[str, str]] = None) -> int:
         with self._lock:
             return self._counters.get((name, label), 0)

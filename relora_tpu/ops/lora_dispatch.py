@@ -39,18 +39,30 @@ import jax.numpy as jnp
 from relora_tpu.ops.pallas_lora_matmul import (
     fused_lora_matmul,
     fused_lora_matmul_int8,
+    grouped_lora_matmul,
+    grouped_lora_reference,
 )
 from relora_tpu.ops.quant import dequantize_int8
 
 __all__ = [
     "ARMS",
+    "GROUPED_ARMS",
     "plan_blocks",
     "estimate_arm_times",
+    "estimate_grouped_arm_times",
     "choose_arm",
+    "choose_grouped_arm",
     "lora_matmul",
+    "lora_matmul_grouped",
 ]
 
 ARMS: Tuple[str, ...] = ("fused", "ordered", "merged")
+
+#: Execution arms for the *multi-tenant* composite, where each activation row
+#: references its own adapter slot (serve/adapters.py).  Disjoint from
+#: :data:`ARMS` on purpose: the single-adapter arms cannot express a mixed
+#: batch, and the grouped arms need the stacked-factor operands.
+GROUPED_ARMS: Tuple[str, ...] = ("grouped", "gathered", "looped")
 
 #: Pallas block-size candidates, largest first.  The minor (lane) dimension
 #: stays a multiple of 128 for Mosaic tiling; the sublane dimension may
@@ -147,6 +159,81 @@ def estimate_arm_times(
         merged = roofline(merged_bytes, base_flops + 2.0 * K * r * N, merged_launches)
 
     return {"fused": fused, "ordered": ordered, "merged": merged}
+
+
+@functools.lru_cache(maxsize=4096)
+def estimate_grouped_arm_times(
+    M: int,
+    K: int,
+    N: int,
+    r: int,
+    num_adapters: int = 1,
+    act_bytes: int = 2,
+    base_bytes: int = 2,
+) -> Dict[str, float]:
+    """Modeled seconds per *grouped* arm for a mixed-tenant batch of M rows
+    touching ``num_adapters`` distinct adapter slots (G).
+
+    - ``grouped`` — the scalar-prefetch kernel: W and the activations stream
+      once, and the factor traffic is ``G·(K·r + r·N)`` — **bytes scale with
+      the distinct adapters touched, not the batch** (the LoRAFusion
+      property this arm exists for).  One launch.
+    - ``gathered`` — XLA gather + batched einsum: materializes a per-row
+      ``A[idx]``/``B[idx]`` copy in HBM, so factor traffic scales with M
+      (read G slabs, write M gathered slabs, read them back).  The
+      correctness fallback off-TPU and over int8 bases.
+    - ``looped`` — split the batch per adapter and run the single-adapter
+      fused kernel G times: G launches, W re-read every launch.
+    """
+    G = max(1, min(num_adapters, M))
+
+    def roofline(nbytes: float, flops: float, launches: int) -> float:
+        return max(nbytes / HBM_BW_BYTES, flops / PEAK_FLOPS) + launches * LAUNCH_OVERHEAD_S
+
+    base_flops = 2.0 * M * K * N
+    lora_flops = 2.0 * M * r * (K + N)
+    w_bytes = float(K * N * base_bytes)
+    slab_bytes = float((K * r + r * N) * act_bytes)
+    act_io = (M * K + M * N) * act_bytes
+
+    grouped = roofline(w_bytes + G * slab_bytes + act_io, base_flops + lora_flops, 1)
+    gathered = roofline(
+        w_bytes + (G + 2.0 * M) * slab_bytes + act_io + 2 * M * N * act_bytes,
+        base_flops + lora_flops,
+        4,
+    )
+    looped = roofline(
+        G * (w_bytes + slab_bytes) + act_io, base_flops + lora_flops, G
+    )
+    return {"grouped": grouped, "gathered": gathered, "looped": looped}
+
+
+@functools.lru_cache(maxsize=4096)
+def choose_grouped_arm(
+    M: int,
+    K: int,
+    N: int,
+    r: int,
+    num_adapters: int = 1,
+    act_bytes: int = 2,
+    base_bytes: int = 2,
+    grouped_available: bool = True,
+    allow: Tuple[str, ...] = GROUPED_ARMS,
+) -> str:
+    """Pick the cheapest grouped arm under the roofline model.
+
+    ``grouped_available=False`` (non-TPU backend, int8 base, or an N with no
+    lane-tile divisor) strikes both kernel arms — ``gathered`` is the
+    always-available reference.  Pure python over static ints (lru_cache'd;
+    no retraces), mirroring :func:`choose_arm`.
+    """
+    times = estimate_grouped_arm_times(M, K, N, r, num_adapters, act_bytes, base_bytes)
+    candidates = [arm for arm in allow if arm in GROUPED_ARMS]
+    if not grouped_available or not any(N % c == 0 for c in BLOCK_N_CANDIDATES):
+        candidates = [a for a in candidates if a not in ("grouped", "looped")]
+    if not candidates:
+        return "gathered"
+    return min(candidates, key=lambda arm: times[arm])
 
 
 @functools.lru_cache(maxsize=4096)
@@ -260,3 +347,71 @@ def lora_matmul(
     # ordered — mirrors models/lora.py's historical base + branch association
     z = jnp.matmul(jnp.matmul(xd, a.astype(dtype)), b.astype(dtype))
     return jnp.matmul(xd, w) + z * scale
+
+
+def lora_matmul_grouped(
+    x: jax.Array,
+    base: Union[jax.Array, Tuple[jax.Array, jax.Array]],
+    a_stack: jax.Array,
+    b_stack: jax.Array,
+    scale_stack: jax.Array,
+    adapter_idx: jax.Array,
+    *,
+    arm: str = "auto",
+    dtype=None,
+    interpret: Optional[bool] = None,
+    num_adapters: Optional[int] = None,
+) -> jax.Array:
+    """Execute the mixed-tenant composite
+    ``y[m] = x[m] @ W + ((x[m] @ A[idx[m]]) @ B[idx[m]]) * s[idx[m]]``.
+
+    ``a_stack``/``b_stack`` are the (num_slots, K, r)/(num_slots, r, N) HBM
+    adapter stacks (serve/adapters.py owns their contents), ``scale_stack``
+    the (num_slots,) per-slot scales, ``adapter_idx`` the (M,) int32 row ->
+    slot map.  ``num_adapters`` is the static distinct-adapter count for the
+    cost model (defaults to min(num_slots, M) — the worst case).  Int8 bases
+    always take the ``gathered`` reference (the grouped kernel is dense-base
+    only).  Inference-only: no VJP.
+    """
+    if arm not in GROUPED_ARMS and arm != "auto":
+        raise ValueError(
+            f"unknown grouped arm {arm!r}; expected one of {GROUPED_ARMS + ('auto',)}"
+        )
+    quantized = isinstance(base, tuple)
+    if quantized:
+        q, qscale = base
+        K, N = q.shape
+        base_bytes = 1
+    else:
+        K, N = base.shape
+        base_bytes = _dtype_bytes(base.dtype)
+    dtype = dtype or x.dtype
+    M = 1
+    for d in x.shape[:-1]:
+        M *= d
+    S, _, r = a_stack.shape
+    if num_adapters is None:
+        num_adapters = min(S, M)
+
+    if arm == "auto":
+        grouped_ok = jax.default_backend() == "tpu" and not quantized
+        arm = choose_grouped_arm(
+            M, K, N, r, num_adapters, _dtype_bytes(dtype), base_bytes,
+            grouped_available=grouped_ok,
+        )
+
+    if arm in ("grouped", "looped") and not quantized:
+        # "looped" exists only as a cost-model rival; execution-wise the
+        # grouped kernel dominates it whenever either is legal.
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return grouped_lora_matmul(
+            x.astype(dtype), base.astype(dtype), a_stack.astype(dtype),
+            b_stack.astype(dtype), scale_stack, adapter_idx,
+            interpret=interpret, out_dtype=dtype,
+        )
+    w = dequantize_int8(q, qscale, dtype) if quantized else base.astype(dtype)
+    return grouped_lora_reference(
+        x.astype(dtype), w, a_stack.astype(dtype), b_stack.astype(dtype),
+        scale_stack, adapter_idx,
+    ).astype(dtype)

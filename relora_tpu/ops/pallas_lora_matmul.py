@@ -44,10 +44,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
     "fused_lora_matmul",
     "fused_lora_matmul_int8",
+    "grouped_lora_matmul",
+    "grouped_lora_reference",
 ]
 
 _F32 = jnp.float32
@@ -129,6 +132,127 @@ def _forward(bm, bn, interpret, out_dtype, x2, base_operands, a, b, s):
         interpret=interpret,
     )(x2, *base_operands, a, b, s)
     return y, z
+
+
+# ---------------------------------------------------------------------------
+# grouped-adapter forward (multi-tenant serving; no VJP — inference only)
+# ---------------------------------------------------------------------------
+
+
+def _grouped_lora_kernel(idx_ref, x_ref, w_ref, a_ref, b_ref, s_ref, out_ref):
+    """One program = one activation row x one N stripe.  The scalar-prefetch
+    ``idx_ref`` steered the BlockSpec index maps, so ``a_ref``/``b_ref``/
+    ``s_ref`` already hold *this row's* adapter slab — the kernel body is the
+    plain fused composite; no gather runs here."""
+    del idx_ref  # consumed by the index maps
+    x = x_ref[:].astype(_F32)  # (1, K)
+    base = jax.lax.dot_general(
+        x, w_ref[:].astype(_F32), (((1,), (0,)), ((), ())), preferred_element_type=_F32
+    )
+    z = jax.lax.dot_general(
+        x, a_ref[0].astype(_F32), (((1,), (0,)), ((), ())), preferred_element_type=_F32
+    )
+    branch = jax.lax.dot_general(
+        z, b_ref[0].astype(_F32), (((1,), (0,)), ((), ())), preferred_element_type=_F32
+    )
+    out_ref[:] = (base + branch * s_ref[0, 0]).astype(out_ref.dtype)
+
+
+def _grouped_forward(bn, interpret, out_dtype, idx, x2, w, a_stack, b_stack, s_stack):
+    M, K = x2.shape
+    S, _, r = a_stack.shape
+    N = w.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(M, N // bn),
+        in_specs=[
+            pl.BlockSpec((1, K), lambda m, j, idx: (m, 0)),
+            pl.BlockSpec((K, bn), lambda m, j, idx: (0, j)),
+            # the block-table mold (ops/attention.paged_decode_attention):
+            # the prefetched per-row slot index selects which HBM adapter
+            # slab the DMA engine streams — no gathered A/B copy in HBM
+            pl.BlockSpec((1, K, r), lambda m, j, idx: (idx[m], 0, 0)),
+            pl.BlockSpec((1, r, bn), lambda m, j, idx: (idx[m], 0, j)),
+            pl.BlockSpec((1, 1), lambda m, j, idx: (idx[m], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bn), lambda m, j, idx: (m, j)),
+    )
+    return pl.pallas_call(
+        _grouped_lora_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(idx, x2, w, a_stack, b_stack, s_stack)
+
+
+def grouped_lora_reference(x, w, a_stack, b_stack, scale_stack, adapter_idx):
+    """Pure-jnp grouped composite: gathers ``A[idx]``/``B[idx]`` per row and
+    contracts batched.  The differential oracle for the kernel, and the
+    execution path for bases the grouped kernel does not handle (int8,
+    off-TPU without interpret)."""
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    x2 = x.reshape(-1, K).astype(_F32)
+    idx = adapter_idx.reshape(-1)
+    a = jnp.take(a_stack, idx, axis=0).astype(_F32)  # (M, K, r)
+    b = jnp.take(b_stack, idx, axis=0).astype(_F32)  # (M, r, N)
+    s = jnp.take(scale_stack.reshape(-1).astype(_F32), idx, axis=0)  # (M,)
+    base = jnp.matmul(x2, w.astype(_F32))
+    z = jnp.einsum("mk,mkr->mr", x2, a)
+    branch = jnp.einsum("mr,mrn->mn", z, b)
+    y = base + branch * s[:, None]
+    return y.astype(x.dtype).reshape(*lead, w.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret", "out_dtype"))
+def grouped_lora_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    a_stack: jax.Array,
+    b_stack: jax.Array,
+    scale_stack: jax.Array,
+    adapter_idx: jax.Array,
+    *,
+    block_n: Optional[int] = None,
+    interpret: bool = False,
+    out_dtype=None,
+) -> jax.Array:
+    """``y[m] = x[m] @ W + ((x[m] @ A[idx[m]]) @ B[idx[m]]) * s[idx[m]]`` for
+    a mixed-tenant batch in one ``pallas_call``.
+
+    ``x``: (..., K) activations whose leading dims flatten to M rows;
+    ``w``: (K, N) shared frozen base; ``a_stack``: (num_slots, K, r);
+    ``b_stack``: (num_slots, r, N); ``scale_stack``: (num_slots,) f32;
+    ``adapter_idx``: (M,) int32 row -> slot map fed through scalar prefetch
+    (the ``paged_decode_attention`` block-table mold), so only the *distinct*
+    adapters a batch touches are ever streamed from HBM.  Grid is
+    (M, N/block_n); inference-only — no VJP is defined.
+    """
+    out_dtype = out_dtype or x.dtype
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = w.shape[1]
+    S, Ka, r = a_stack.shape
+    if Ka != K or w.shape[0] != K:
+        raise ValueError(f"contraction mismatch: x K={K}, base {w.shape}, A {a_stack.shape}")
+    if b_stack.shape != (S, r, N):
+        raise ValueError(
+            f"B stack {b_stack.shape} does not match A stack {a_stack.shape} / base N={N}"
+        )
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    bn = block_n or _largest_divisor(N, (512, 256, 128))
+    if N % bn:
+        raise ValueError(f"N={N} must tile by block_n={bn}")
+    idx = adapter_idx.reshape(-1).astype(jnp.int32)
+    if idx.shape[0] != M:
+        raise ValueError(
+            f"adapter_idx has {idx.shape[0]} rows but x flattens to M={M} "
+            "(expand per-batch indices to per-row before the kernel)"
+        )
+    s = scale_stack.reshape(-1, 1).astype(_F32)
+    y = _grouped_forward(bn, interpret, out_dtype, idx, x2, w, a_stack, b_stack, s)
+    return y.reshape(*lead, N)
 
 
 # ---------------------------------------------------------------------------

@@ -77,6 +77,58 @@ def bucket_length(n: int, minimum: int = 16) -> int:
     return max(minimum, 1 << (n - 1).bit_length())
 
 
+# multi-tenant slot writes: stacked lora_a/lora_b leaves are
+# (…, num_slots, in, r) / (…, num_slots, r, out) — the slot axis sits at
+# ndim-3 (a leading scan-layers axis may precede it); the per-slot scale
+# lora_s is (…, num_slots) with the slot axis last
+_LORA_FACTOR_LEAVES = ("lora_a", "lora_b")
+
+
+def _factor_slot_axis(stacked) -> int:
+    return stacked.ndim - 3
+
+
+def _set_adapter_slot(stacked, block, slot):
+    axis = _factor_slot_axis(stacked)
+    block = jnp.expand_dims(jnp.asarray(block).astype(stacked.dtype), axis)
+    starts = [0] * stacked.ndim
+    starts[axis] = slot
+    return jax.lax.dynamic_update_slice(stacked, block, tuple(starts))
+
+
+def _set_adapter_scale(s_leaf, scale, slot):
+    shape = list(s_leaf.shape)
+    shape[-1] = 1
+    block = jnp.full(tuple(shape), scale, s_leaf.dtype)
+    starts = [0] * s_leaf.ndim
+    starts[-1] = slot
+    return jax.lax.dynamic_update_slice(s_leaf, block, tuple(starts))
+
+
+def _write_adapter_slot_tree(params, factors, scale, slot):
+    """Pure slot overwrite: returns ``params`` with adapter ``slot``'s
+    lora_a/lora_b slabs replaced by ``factors`` (zeros where the adapter has
+    no factor for a module) and its lora_s entry set to ``scale``.  ``slot``
+    and ``scale`` are traced — one compile serves every load/evict/swap."""
+    out = {}
+    for key, value in params.items():
+        f = factors.get(key) if isinstance(factors, dict) else None
+        if isinstance(value, dict):
+            out[key] = _write_adapter_slot_tree(
+                value, f if isinstance(f, dict) else {}, scale, slot
+            )
+        elif key in _LORA_FACTOR_LEAVES:
+            if f is None:
+                axis = _factor_slot_axis(value)
+                f = jnp.zeros(value.shape[:axis] + value.shape[axis + 1 :], value.dtype)
+            out[key] = _set_adapter_slot(value, f, slot)
+        elif key == "lora_s":
+            out[key] = _set_adapter_scale(value, scale, slot)
+        else:
+            out[key] = value
+    return out
+
+
 def build_decode_model(
     model_cfg: ModelConfig,
     *,
@@ -88,6 +140,7 @@ def build_decode_model(
     page_size: int = 0,
     num_pages: int = 0,
     kv_dtype: str = "bf16",
+    adapter_slots: int = 0,
 ):
     """The serving twin of train.trainer.build_model: same family dispatch,
     decode cache enabled, no remat.  ``lora=None`` (the default) serves a
@@ -97,12 +150,19 @@ def build_decode_model(
     ``weights_static`` tells ops/lora_dispatch's cost model that W/A/B are
     constant across steps, and ``fused=False`` is promoted to ``"auto"`` so
     the decode forward actually routes through the dispatcher — which picks
-    the merged ``x @ (W + s·A@B)`` arm at decode-sized M."""
+    the merged ``x @ (W + s·A@B)`` arm at decode-sized M.
+
+    ``adapter_slots > 0`` switches every LoRA leaf to the stacked
+    multi-tenant layout (models/lora.py ``num_slots``): factors become
+    ``(adapter_slots, …)`` HBM slabs and every forward takes a per-row
+    ``adapter_idx`` routed through the grouped kernel.  Slot 0 is the
+    zero-initialized identity adapter."""
     if lora is not None:
         lora = dataclasses.replace(
             lora,
             weights_static=True,
             fused="auto" if lora.fused is False else lora.fused,
+            num_slots=adapter_slots if adapter_slots else lora.num_slots,
         )
     kwargs = dict(
         config=model_cfg,
@@ -155,9 +215,22 @@ class InferenceEngine:
         chunk_size: int = 64,
         kv_dtype: str = "bf16",
         spec_k: int = 0,
+        adapter_slots: int = 0,
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
+        if adapter_slots:
+            if lora is None:
+                raise ValueError(
+                    "adapter_slots > 0 requires the checkpoint's LoraSpec "
+                    "(multi-tenant serving runs the factors unmerged)"
+                )
+            if adapter_slots < 2:
+                raise ValueError(
+                    f"adapter_slots must be >= 2 (slot 0 is the identity "
+                    f"adapter), got {adapter_slots}"
+                )
+        self.adapter_slots = adapter_slots
         if kv_dtype not in ("bf16", "int8"):
             raise ValueError(f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}")
         if kv_dtype == "int8" and page_size is None:
@@ -218,7 +291,15 @@ class InferenceEngine:
             scan_layers=scan_layers,
             attention_impl=attention_impl,
             lora=lora,
+            adapter_slots=adapter_slots,
         )
+        if adapter_slots:
+            # the checkpoint carries unstacked (in, r) factors; the slotted
+            # model wants (num_slots, in, r) slabs.  Rebuild: non-LoRA leaves
+            # from the checkpoint, LoRA leaves fresh (zeros / spec scale) so
+            # slot 0 is the identity adapter — the base checkpoint's own A/B
+            # are deliberately dropped (tenants load theirs via the registry)
+            params = self._stack_adapter_params(params, lora)
         params = jax.tree_util.tree_map(jnp.asarray, params)
         if mesh is not None:
             from relora_tpu.models.params_util import logical_partition_specs
@@ -229,15 +310,17 @@ class InferenceEngine:
             params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         self.params = params
 
-        def prefill_fn(p, ids, positions, cache):
+        def prefill_fn(p, ids, positions, cache, adapter_idx):
             logits, variables = self.model.apply(
-                {"params": p, "cache": cache}, ids, positions=positions, mutable=["cache"]
+                {"params": p, "cache": cache}, ids, positions=positions,
+                adapter_idx=adapter_idx, mutable=["cache"]
             )
             return logits, variables["cache"]
 
-        def decode_fn(p, cache, token, pos):
+        def decode_fn(p, cache, token, pos, adapter_idx):
             logits, variables = self.model.apply(
-                {"params": p, "cache": cache}, token, positions=pos, mutable=["cache"]
+                {"params": p, "cache": cache}, token, positions=pos,
+                adapter_idx=adapter_idx, mutable=["cache"]
             )
             return logits[:, -1, :], variables["cache"]
 
@@ -261,6 +344,15 @@ class InferenceEngine:
         self._insert = cw.wrap("insert", jax.jit(insert_fn, donate_argnums=(0,)))
         self._sample = jax.jit(sample, static_argnames=("top_k",))
 
+        if adapter_slots:
+            # slot writes donate the param tree and trace slot/scale: every
+            # adapter load/evict/swap reuses one compiled program (the
+            # zero-steady-state-retrace contract for mid-traffic churn)
+            self._write_slot = cw.wrap(
+                "adapter_write", jax.jit(_write_adapter_slot_tree, donate_argnums=(0,))
+            )
+            self._factor_template = self._adapter_factor_template()
+
         if self.paged:
             # a second model instance over the same params: cache variables
             # are the shared (num_pages, page_size, n_kv, head_dim) pool and
@@ -276,24 +368,27 @@ class InferenceEngine:
                 page_size=self.page_size,
                 num_pages=self.num_pages,
                 kv_dtype=kv_dtype,
+                adapter_slots=adapter_slots,
             )
 
-            def prefill_chunk_fn(p, ids, positions, pool, block_tables):
+            def prefill_chunk_fn(p, ids, positions, pool, block_tables, adapter_idx):
                 logits, variables = self.paged_model.apply(
                     {"params": p, "cache": pool},
                     ids,
                     positions=positions,
                     block_tables=block_tables,
+                    adapter_idx=adapter_idx,
                     mutable=["cache"],
                 )
                 return logits, variables["cache"]
 
-            def decode_paged_fn(p, pool, token, pos, block_tables):
+            def decode_paged_fn(p, pool, token, pos, block_tables, adapter_idx):
                 logits, variables = self.paged_model.apply(
                     {"params": p, "cache": pool},
                     token,
                     positions=pos,
                     block_tables=block_tables,
+                    adapter_idx=adapter_idx,
                     mutable=["cache"],
                 )
                 return logits[:, -1, :], variables["cache"]
@@ -361,25 +456,151 @@ class InferenceEngine:
             shardings,
         )
 
+    # -- multi-tenant adapter slots (adapter_slots set at construction) ------
+
+    def _stack_adapter_params(self, params: PyTree, lora: LoraSpec) -> PyTree:
+        """Rebuild the checkpoint tree for the slotted model: every non-LoRA
+        leaf comes from the checkpoint, every lora_a/lora_b leaf becomes its
+        zero stacked ``(num_slots, …)`` twin and lora_s fills with the spec
+        scale — so every slot starts as the identity adapter."""
+        from flax import linen as nn
+
+        shapes = nn.meta.unbox(
+            jax.eval_shape(
+                lambda: self.model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32))
+            )["params"]
+        )
+        params = nn.meta.unbox(params)
+
+        def merge(ckpt, init):
+            out = {}
+            for key, value in init.items():
+                if isinstance(value, dict):
+                    sub = ckpt.get(key) if isinstance(ckpt, dict) else None
+                    out[key] = merge(sub if isinstance(sub, dict) else {}, value)
+                elif key in _LORA_FACTOR_LEAVES:
+                    out[key] = jnp.zeros(value.shape, value.dtype)
+                elif key == "lora_s":
+                    out[key] = jnp.full(value.shape, lora.scale, value.dtype)
+                else:
+                    if not isinstance(ckpt, dict) or key not in ckpt:
+                        raise ValueError(
+                            f"checkpoint is missing param leaf {key!r} required "
+                            "by the slotted decode model"
+                        )
+                    # copy, don't alias: slot writes donate the whole param
+                    # tree, and donating a buffer the caller still holds
+                    # would delete it out from under them
+                    out[key] = jnp.array(ckpt[key], copy=True)
+            return out
+
+        return merge(params, shapes)
+
+    def _adapter_factor_template(self) -> PyTree:
+        """Zero factors tree shaped like one adapter's lora_a/lora_b leaves
+        (the stacked leaves minus the slot axis).  Every real load is cast
+        onto this template so the slot-write jit sees one signature."""
+
+        def walk(p):
+            out = {}
+            for key, value in p.items():
+                if isinstance(value, dict):
+                    sub = walk(value)
+                    if sub:
+                        out[key] = sub
+                elif key in _LORA_FACTOR_LEAVES:
+                    axis = _factor_slot_axis(value)
+                    out[key] = jnp.zeros(
+                        value.shape[:axis] + value.shape[axis + 1 :], value.dtype
+                    )
+            return out
+
+        return walk(self.params)
+
+    def _require_slots(self):
+        if not self.adapter_slots:
+            raise ValueError("engine was built without adapter_slots: no slot writes")
+
+    def write_adapter_slot(self, slot: int, factors: PyTree, scale: float) -> None:
+        """Copy one adapter's unmerged factors into HBM slot ``slot`` (a
+        traced dynamic_update_slice over the donated param tree — pure data
+        movement, zero steady-state retraces).  ``factors`` is the
+        lora_a/lora_b subtree an AdapterRegistry loader returns; leaves are
+        cast onto the engine's template so dtype drift between checkpoints
+        cannot change the compiled signature."""
+        self._require_slots()
+        if not (0 < slot < self.adapter_slots):
+            raise ValueError(
+                f"slot must be in [1, {self.adapter_slots}) (slot 0 is the "
+                f"identity adapter), got {slot}"
+            )
+
+        def cast(tmpl, f):
+            out = {}
+            for key, value in tmpl.items():
+                sub = f.get(key) if isinstance(f, dict) else None
+                if isinstance(value, dict):
+                    out[key] = cast(value, sub if isinstance(sub, dict) else {})
+                elif sub is None:
+                    out[key] = value  # module the adapter does not touch: zeros
+                else:
+                    leaf = jnp.asarray(sub)
+                    if leaf.shape != value.shape:
+                        raise ValueError(
+                            f"adapter factor {key!r} has shape {leaf.shape}, "
+                            f"expected {value.shape}"
+                        )
+                    out[key] = leaf.astype(value.dtype)
+            return out
+
+        self.params = self._write_slot(
+            self.params,
+            cast(self._factor_template, factors),
+            jnp.asarray(scale, jnp.float32),
+            jnp.asarray(slot, jnp.int32),
+        )
+
+    def adapter_writer(self):
+        """The ``writer(slot, factors, scale)`` callback an AdapterRegistry
+        wants (serve/adapters.py)."""
+        self._require_slots()
+        return lambda slot, factors, scale: self.write_adapter_slot(slot, factors, scale)
+
+    def _row_idx(self, adapter_idx, rows: int) -> jax.Array:
+        """Normalize an optional per-row adapter index to a concrete (rows,)
+        int32 array (None -> all slot 0, the identity adapter)."""
+        if adapter_idx is None:
+            return jnp.zeros((rows,), jnp.int32)
+        idx = jnp.asarray(adapter_idx, jnp.int32)
+        if idx.shape != (rows,):
+            raise ValueError(f"adapter_idx must have shape ({rows},), got {idx.shape}")
+        return idx
+
     # -- step functions ------------------------------------------------------
 
-    def prefill(self, ids: jax.Array, lengths=None) -> Tuple[jax.Array, PyTree]:
+    def prefill(self, ids: jax.Array, lengths=None, adapter_idx=None) -> Tuple[jax.Array, PyTree]:
         """Run a right-padded prompt batch ``(B, T)``; returns full logits
         ``(B, T, V)`` and the populated cache.  ``T`` must be <= cache_size
-        (bucket prompts with ``bucket_length`` before calling)."""
+        (bucket prompts with ``bucket_length`` before calling).
+        ``adapter_idx`` is an optional ``(B,)`` slot index per row (slot 0 —
+        the identity adapter — when omitted)."""
         B, T = ids.shape
         if T > self.cache_size:
             raise ValueError(f"prompt length {T} exceeds cache capacity {self.cache_size}")
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
         cache = self.init_cache(B)
-        return self._prefill(self.params, jnp.asarray(ids), positions, cache)
+        return self._prefill(
+            self.params, jnp.asarray(ids), positions, cache, self._row_idx(adapter_idx, B)
+        )
 
-    def decode(self, cache: PyTree, token: jax.Array, pos: jax.Array) -> Tuple[jax.Array, PyTree]:
+    def decode(self, cache: PyTree, token: jax.Array, pos: jax.Array, adapter_idx=None) -> Tuple[jax.Array, PyTree]:
         """One decode step: ``token``/``pos`` are ``(B, 1)``; returns logits
         ``(B, V)`` and the updated cache.  The input cache is donated —
         the caller must not reuse it after this call."""
+        B = token.shape[0]
         return self._decode(
-            self.params, cache, jnp.asarray(token), jnp.asarray(pos, jnp.int32)
+            self.params, cache, jnp.asarray(token), jnp.asarray(pos, jnp.int32),
+            self._row_idx(adapter_idx, B),
         )
 
     def insert(self, dcache: PyTree, pcache: PyTree, slot) -> PyTree:
@@ -461,7 +682,7 @@ class InferenceEngine:
         )
 
     def prefill_chunk(
-        self, ids: jax.Array, start: int, pool: PyTree, block_table
+        self, ids: jax.Array, start: int, pool: PyTree, block_table, adapter_idx=None
     ) -> Tuple[jax.Array, PyTree]:
         """Prefill one fixed-size chunk of a single prompt: ``ids`` is
         ``(1, chunk_size)`` (right-padded past the prompt), written at
@@ -481,10 +702,11 @@ class InferenceEngine:
             positions,
             pool,
             jnp.asarray(block_table, jnp.int32),
+            self._row_idx(adapter_idx, B),
         )
 
     def decode_paged(
-        self, pool: PyTree, token: jax.Array, pos: jax.Array, block_tables
+        self, pool: PyTree, token: jax.Array, pos: jax.Array, block_tables, adapter_idx=None
     ) -> Tuple[jax.Array, PyTree]:
         """One paged decode step: ``token``/``pos`` are ``(B, 1)``,
         ``block_tables`` is ``(B, W)``.  Rows without an active decoding
@@ -498,10 +720,11 @@ class InferenceEngine:
             jnp.asarray(token),
             jnp.asarray(pos, jnp.int32),
             jnp.asarray(block_tables, jnp.int32),
+            self._row_idx(adapter_idx, token.shape[0]),
         )
 
     def verify_paged(
-        self, pool: PyTree, tokens: jax.Array, pos: jax.Array, block_tables
+        self, pool: PyTree, tokens: jax.Array, pos: jax.Array, block_tables, adapter_idx=None
     ) -> Tuple[jax.Array, PyTree]:
         """Speculative verify step: ``tokens``/``pos`` are ``(B, S)`` with
         ``S = spec_k + 1`` (last committed token followed by the drafted
@@ -524,6 +747,7 @@ class InferenceEngine:
             jnp.asarray(pos, jnp.int32),
             pool,
             jnp.asarray(block_tables, jnp.int32),
+            self._row_idx(adapter_idx, tokens.shape[0]),
         )
 
     def default_prompt_buckets(self) -> Tuple[int, ...]:
@@ -580,6 +804,13 @@ class InferenceEngine:
                         jnp.full((batch, S), self.cache_size, jnp.int32),
                         jnp.zeros((batch, self.block_table_width + 1), jnp.int32),
                     )
+                if self.adapter_slots:
+                    # zeros into the last free slot: a no-op write that
+                    # compiles the one slot-write program before any tenant
+                    # load (warm up BEFORE preloading adapters)
+                    self.write_adapter_slot(
+                        self.adapter_slots - 1, self._factor_template, 0.0
+                    )
                 jax.block_until_ready(logits)
             events = cw.compile_events()[n_before:]
             shapes = {
@@ -588,6 +819,8 @@ class InferenceEngine:
             }
             if self.spec_k > 0:
                 shapes["verify_paged"] = [batch, self.spec_k + 1]
+            if self.adapter_slots:
+                shapes["adapter_write"] = [self.adapter_slots]
             return {
                 "batch": batch,
                 "prompt_buckets": [],
@@ -616,16 +849,23 @@ class InferenceEngine:
             logits, cache = self.decode(
                 cache, jnp.zeros((batch, 1), jnp.int32), jnp.zeros((batch, 1), jnp.int32)
             )
+            if self.adapter_slots:
+                self.write_adapter_slot(
+                    self.adapter_slots - 1, self._factor_template, 0.0
+                )
             jax.block_until_ready(logits)
         events = cw.compile_events()[n_before:]
+        shapes = {
+            "prefill": [[1, T] for T in buckets],
+            "insert": [[batch], [1]],
+            "decode": [batch, 1],
+        }
+        if self.adapter_slots:
+            shapes["adapter_write"] = [self.adapter_slots]
         return {
             "batch": batch,
             "prompt_buckets": buckets,
-            "shapes": {
-                "prefill": [[1, T] for T in buckets],
-                "insert": [[batch], [1]],
-                "decode": [batch, 1],
-            },
+            "shapes": shapes,
             "n_compiles": len(events),
             "compiles": [
                 {"fn": ev.fn, "duration_s": round(ev.duration_s, 4), "reason": ev.reason}
@@ -660,6 +900,7 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((1, self.chunk_size), i32),
                 pool,
                 jax.ShapeDtypeStruct((1, self.block_table_width), i32),
+                jax.ShapeDtypeStruct((1,), i32),
             )
             plans["decode_paged"] = obs_memory.plan_for(
                 self._decode_paged,
@@ -668,6 +909,7 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((batch, 1), i32),
                 jax.ShapeDtypeStruct((batch, 1), i32),
                 jax.ShapeDtypeStruct((batch, self.block_table_width), i32),
+                jax.ShapeDtypeStruct((batch,), i32),
             )
             if self.spec_k > 0:
                 S = self.spec_k + 1
@@ -678,6 +920,7 @@ class InferenceEngine:
                     jax.ShapeDtypeStruct((batch, S), i32),
                     pool,
                     jax.ShapeDtypeStruct((batch, self.block_table_width + 1), i32),
+                    jax.ShapeDtypeStruct((batch,), i32),
                 )
             return plans
         if prompt_buckets is None:
@@ -699,6 +942,7 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((1, T), i32),
                 jax.ShapeDtypeStruct((1, T), i32),
                 pcache1,
+                jax.ShapeDtypeStruct((1,), i32),
             )
         plans["insert"] = obs_memory.plan_for(
             self._insert, dcache, pcache1, jax.ShapeDtypeStruct((), i32)
@@ -709,6 +953,7 @@ class InferenceEngine:
             dcache,
             jax.ShapeDtypeStruct((batch, 1), i32),
             jax.ShapeDtypeStruct((batch, 1), i32),
+            jax.ShapeDtypeStruct((batch,), i32),
         )
         return plans
 
@@ -722,6 +967,7 @@ class InferenceEngine:
         sampling: SamplingParams = SamplingParams(),
         eos_id: Optional[int] = None,
         key: Optional[jax.Array] = None,
+        adapter_idx: Optional[Sequence[int]] = None,
     ) -> List[List[int]]:
         """Batch generation without continuous batching: pad all prompts to one
         bucket, prefill, then decode until every row hits EOS/max_new_tokens.
@@ -745,7 +991,10 @@ class InferenceEngine:
         for i, p in enumerate(prompts):
             ids[i, : lengths[i]] = np.asarray(p, np.int32)
 
-        logits, cache = self.prefill(jnp.asarray(ids), lengths)
+        idx = None
+        if adapter_idx is not None:
+            idx = jnp.asarray(adapter_idx, jnp.int32)
+        logits, cache = self.prefill(jnp.asarray(ids), lengths, adapter_idx=idx)
         last = jnp.take_along_axis(
             logits, jnp.asarray(lengths - 1)[:, None, None], axis=1
         )[:, 0, :]
@@ -768,7 +1017,7 @@ class InferenceEngine:
                         done[i] = True
             if done.all() or step == max_new_tokens - 1:
                 break
-            logits, cache = self.decode(cache, token[:, None], pos[:, None])
+            logits, cache = self.decode(cache, token[:, None], pos[:, None], adapter_idx=idx)
             pos = pos + 1
             token = self._sample(
                 logits,

@@ -17,7 +17,12 @@ Three mechanisms, composed:
   smallest load score — the router's own in-flight count plus the replica's
   last-reported ``queue_depth + active_slots`` (ties rotate).  The score is
   at most one probe interval stale, which is exactly the staleness the
-  in-flight count compensates for.
+  in-flight count compensates for.  Multi-tenant requests (an ``"adapter"``
+  body field) get **tenant affinity** first: the adapter name is
+  rendezvous-hashed over the routable groups so each tenant keeps hitting
+  one replica (its HBM adapter slot stays warm instead of loading on every
+  replica); the least-loaded pick is the fallback whenever the home replica
+  is unroutable, already tried, or its circuit breaker is open.
 - **Per-replica circuit breaker.**  ``failure_threshold`` consecutive
   connect errors or 5xx responses open the circuit; after a cooldown
   (doubling per consecutive open, capped) one half-open trial — a health
@@ -57,6 +62,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
 import json
 import os
 import threading
@@ -443,17 +449,40 @@ class Router:
 
     # -- selection -----------------------------------------------------------
 
-    def _pick(self, exclude: Set[str]) -> Optional[ReplicaState]:
+    def _pick(
+        self, exclude: Set[str], adapter: Optional[str] = None
+    ) -> Optional[ReplicaState]:
         # a group is routable only when every shard is healthy; requests go
         # to its primary (lowest rid), scored by the whole group's load
         candidates: List[Tuple[ReplicaState, int]] = []
-        for members in self._groups().values():
+        routable_groups: List[str] = []
+        for gid, members in self._groups().items():
             if not all(st.healthy and st.port is not None for st in members):
                 continue
+            routable_groups.append(gid)
             primary = min(members, key=lambda s: s.rid)
             if primary.rid in exclude:
                 continue
             candidates.append((primary, sum(st.load() for st in members)))
+        if adapter is not None and routable_groups:
+            # tenant affinity: rendezvous-hash the adapter over the routable
+            # groups so each tenant keeps hitting one replica (its slot pool
+            # stays warm — no cross-fleet slot thrash) and keeps its home as
+            # long as that group stays up.  Fall back to least-loaded when
+            # the home is excluded (already tried) or its breaker won't
+            # admit a request.
+            home = max(
+                routable_groups,
+                key=lambda g: hashlib.sha1(f"{adapter}:{g}".encode()).digest(),
+            )
+            for st, _load in candidates:
+                if (st.group or st.rid) != home:
+                    continue
+                if st.breaker.state == "closed" or st.breaker.allow():
+                    self.stats.inc("affinity_routed_total", ("replica", st.rid))
+                    return st
+                break
+            self.stats.inc("affinity_fallback_total")
         ready = [(st, load) for st, load in candidates if st.breaker.state == "closed"]
         if not ready:
             # no closed circuit: offer half-open trials (allow() mutates)
@@ -572,13 +601,23 @@ class Router:
         headers: Dict[str, str],
     ) -> None:
         rid_hdr = (headers.get("x-request-id") or "").strip() or new_trace_id()
+        # tenant affinity key: a parse failure routes anywhere and the
+        # replica's own body validation answers the 400
+        adapter: Optional[str] = None
+        try:
+            payload = json.loads(body.decode("utf-8") or "{}")
+            name = payload.get("adapter") if isinstance(payload, dict) else None
+            if isinstance(name, str) and name.strip():
+                adapter = name.strip()
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            pass
         # root span of this process's share of the request: trace_id is the
         # request id, the same id the replica uses for its own spans, so the
         # merged trace (tools/trace_report.py) shows router -> replica ->
         # model thread as one tree
-        root = self.tracer.start_span("route", trace_id=rid_hdr)
+        root = self.tracer.start_span("route", trace_id=rid_hdr, adapter=adapter)
         try:
-            outcome = await self._proxy_attempts(writer, body, rid_hdr, root)
+            outcome = await self._proxy_attempts(writer, body, rid_hdr, root, adapter)
         finally:
             root.set(outcome=outcome if isinstance(outcome, str) else "error").end()
 
@@ -588,6 +627,7 @@ class Router:
         body: bytes,
         rid_hdr: str,
         root,
+        adapter: Optional[str] = None,
     ) -> str:
         # shared across attempts: once any SSE body byte reaches the client,
         # the request is no longer retryable (the idempotency boundary)
@@ -596,7 +636,7 @@ class Router:
         backoff = self.retry_backoff_s
         passthrough: Optional[Tuple[int, Dict[str, str], bytes]] = None
         for attempt in range(self.max_attempts):
-            st = self._pick(exclude=set(tried))
+            st = self._pick(exclude=set(tried), adapter=adapter)
             if st is None:
                 break
             tried.append(st.rid)

@@ -75,7 +75,9 @@ class Request:
     ``top_k`` is batch-global (static shape) and lives on the scheduler.
     ``spec`` opts this request out of speculative drafting (``False``) when
     the scheduler runs with it on — output distribution is identical either
-    way; turning it off just skips the draft/verify work for this row."""
+    way; turning it off just skips the draft/verify work for this row.
+    ``adapter`` names a tenant LoRA adapter (serve/adapters.py registry);
+    ``None`` decodes the base model (slot 0, the identity adapter)."""
 
     uid: int
     prompt: Sequence[int]
@@ -83,6 +85,7 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     spec: bool = True
+    adapter: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -105,6 +108,7 @@ class _Slot:
     t_first: float
     deadline: Optional[float] = None  # absolute time.monotonic(), None = no limit
     span: Optional[Any] = None  # per-request "decode" span; ended at retire
+    adapter_slot: int = 0  # HBM slot this request's adapter is pinned to
 
 
 class ContinuousBatchingScheduler:
@@ -121,14 +125,21 @@ class ContinuousBatchingScheduler:
         key: Optional[jax.Array] = None,
         tracer: Optional[Any] = None,
         obs_registry: Optional[Any] = None,
+        adapter_registry: Optional[Any] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if adapter_registry is not None and not getattr(engine, "adapter_slots", 0):
+            raise ValueError(
+                "adapter_registry needs an engine built with adapter_slots "
+                "(the stacked multi-tenant LoRA layout)"
+            )
         self.engine = engine
         self.max_batch = max_batch
         self.eos_id = eos_id
         self.top_k = top_k
         self.metrics = metrics
+        self.adapter_registry = adapter_registry
         # tracing defaults to no-op so the batch CLI pays nothing; the HTTP
         # server injects its Tracer + ServeMetrics (per-phase histograms)
         self.tracer = tracer if tracer is not None else NoopTracer()
@@ -140,6 +151,10 @@ class ContinuousBatchingScheduler:
         self._cache = None  # allocated on first admission, then persistent
         self._tokens = np.zeros(max_batch, np.int32)
         self._positions = np.zeros(max_batch, np.int32)
+        # per-row adapter slot indices for the grouped LoRA kernel; free rows
+        # point at slot 0 (the identity adapter) so their garbage decode is
+        # pure base-model work
+        self._adapter_row = np.zeros(max_batch, np.int32)
         self._deadlines: Dict[int, float] = {}
         self._on_token: Dict[int, TokenCallback] = {}
         self._on_finish: Dict[int, FinishCallback] = {}
@@ -168,6 +183,16 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request {req.uid}: max_new_tokens must be >= 1, got {req.max_new_tokens}"
             )
+        if req.adapter is not None:
+            if self.adapter_registry is None:
+                raise ValueError(
+                    f"request {req.uid}: server is not running with an adapter "
+                    "registry (--adapter-dir); 'adapter' is not accepted"
+                )
+            if not self.adapter_registry.known(req.adapter):
+                raise ValueError(
+                    f"request {req.uid}: unknown adapter {req.adapter!r}"
+                )
 
     def submit(
         self,
@@ -250,6 +275,13 @@ class ContinuousBatchingScheduler:
     def active_slots(self) -> int:
         return sum(s is not None for s in self._slots)
 
+    def adapter_stats(self) -> Optional[Dict[str, Any]]:
+        """Registry occupancy/churn counters for /healthz, or None when the
+        server runs without multi-tenant adapters."""
+        if self.adapter_registry is None:
+            return None
+        return self.adapter_registry.stats()
+
     def step(self) -> List[Completion]:
         """One admit-plus-decode round: expire deadlines, fill free slots
         from the pending queue, then run one jitted decode over all slots.
@@ -283,6 +315,7 @@ class ContinuousBatchingScheduler:
                 self._cache,
                 jnp.asarray(self._tokens)[:, None],
                 jnp.asarray(self._positions)[:, None],
+                adapter_idx=self._adapter_row,
             )
             self._step_count += 1
             # one bulk pull for the whole batch, then plain Python ints —
@@ -307,23 +340,25 @@ class ContinuousBatchingScheduler:
             self._positions[slot_idx] = slot.pos
             self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
             self._finish_if_done(slot_idx, finished)
+        record = None
         if self.metrics is not None:
             watcher = getattr(self.engine, "compile_watcher", None)
-            self.metrics.log(
-                {
-                    "serve/decode_step": self._step_count,
-                    "serve/queue_depth": len(self._pending),
-                    "serve/active_slots": self.active_slots,
-                    "serve/batch_fill": round(batch_fill, 4),
-                    "serve/prefill_stall_s": round(admit_s, 6),
-                    "serve/prefill_stall_share": round(stall_share, 4),
-                    # a nonzero here after warmup means a shape escaped the
-                    # warmed buckets — see docs/operations.md troubleshooting
-                    "compile/steady_state_retraces": (
-                        watcher.steady_state_retraces if watcher is not None else 0
-                    ),
-                }
-            )
+            record = {
+                "serve/decode_step": self._step_count,
+                "serve/queue_depth": len(self._pending),
+                "serve/active_slots": self.active_slots,
+                "serve/batch_fill": round(batch_fill, 4),
+                "serve/prefill_stall_s": round(admit_s, 6),
+                "serve/prefill_stall_share": round(stall_share, 4),
+                # a nonzero here after warmup means a shape escaped the
+                # warmed buckets — see docs/operations.md troubleshooting
+                "compile/steady_state_retraces": (
+                    watcher.steady_state_retraces if watcher is not None else 0
+                ),
+            }
+        self._adapter_gauges(record)
+        if record is not None:
+            self.metrics.log(record)
         return finished
 
     def run(self, requests: Iterable[Request]) -> Dict[int, Completion]:
@@ -368,8 +403,24 @@ class ContinuousBatchingScheduler:
                 # prefill on it; the slot stays free for the next admission
                 finished.append(self._finalize_unadmitted(req, "timeout"))
                 continue
+            try:
+                adapter_slot = self._acquire_adapter(req)
+            except Exception as e:
+                logger.warning(f"request {req.uid}: adapter load failed: {e!r}")
+                finished.append(
+                    self._finalize_unadmitted(req, "error", f"adapter load failed: {e}")
+                )
+                continue
+            if adapter_slot is None:
+                # every adapter slot pinned by live traffic: stay queued
+                # (FIFO — later requests do not jump the head) and retry
+                # after a retirement drops a pin
+                self._pending.appendleft(req)
+                return
             t_admit = time.monotonic()
-            self._cache, first = self._admit(req, slot_idx, self._ensure_cache())
+            self._cache, first = self._admit(
+                req, slot_idx, self._ensure_cache(), adapter_slot
+            )
             self._slots[slot_idx] = _Slot(
                 request=req,
                 pos=len(req.prompt),
@@ -381,9 +432,11 @@ class ContinuousBatchingScheduler:
                 span=self.tracer.start_span(
                     "decode", trace_id=self._trace_ids.get(req.uid), uid=req.uid
                 ),
+                adapter_slot=adapter_slot,
             )
             self._tokens[slot_idx] = first
             self._positions[slot_idx] = len(req.prompt)
+            self._adapter_row[slot_idx] = adapter_slot
             self._emit_token(req.uid, first, 0)
             self._finish_if_done(slot_idx, finished)
 
@@ -392,7 +445,41 @@ class ContinuousBatchingScheduler:
             self._cache = self.engine.init_cache(self.max_batch)
         return self._cache
 
-    def _admit(self, req: Request, slot_idx: int, cache):
+    def _acquire_adapter(self, req: Request) -> Optional[int]:
+        """Pin the request's adapter for admission.  Returns its HBM slot
+        index, or ``None`` when every slot is pinned by live traffic — the
+        caller keeps the request queued and retries next round (the prefix
+        cache's evict-then-retry contract).  Raises when the adapter fails
+        to load (bad checkpoint dir)."""
+        if self.adapter_registry is None:
+            return 0
+        return self.adapter_registry.acquire(req.adapter)
+
+    def _release_adapter(self, req: Request) -> None:
+        if self.adapter_registry is not None and req.adapter is not None:
+            self.adapter_registry.release(req.adapter)
+
+    def _count_adapter_request(self, req: Request) -> None:
+        if self.adapter_registry is not None and self.obs_registry is not None:
+            self.obs_registry.inc(
+                "adapter_requests_total", label=("adapter", req.adapter or "base")
+            )
+
+    def _adapter_gauges(self, record: Optional[Dict[str, Any]] = None) -> None:
+        """Publish registry occupancy next to the step's other gauges (and
+        into the step's metrics.jsonl record when one is being built)."""
+        if self.adapter_registry is None:
+            return
+        stats = self.adapter_registry.stats()
+        if self.obs_registry is not None:
+            self.obs_registry.set_gauge("adapter_slots_used", stats["slots_used"])
+            self.obs_registry.set_gauge("adapter_hit_rate", stats["hit_rate"])
+        if record is not None:
+            record["serve/adapter_slots_used"] = stats["slots_used"]
+            record["serve/adapter_evictions_total"] = stats["evictions_total"]
+            record["serve/adapter_hit_rate"] = stats["hit_rate"]
+
+    def _admit(self, req: Request, slot_idx: int, cache, adapter_slot: int = 0):
         """Prefill one request (batch of 1, bucketed length) and copy its
         cache row into ``slot_idx``.  Returns (cache, first sampled token)."""
         L = len(req.prompt)
@@ -407,7 +494,10 @@ class ContinuousBatchingScheduler:
         with self.tracer.span(
             "prefill", trace_id=tid, uid=req.uid, prompt_tokens=L, bucket=T
         ):
-            logits, pcache = self.engine.prefill(jnp.asarray(ids))
+            logits, pcache = self.engine.prefill(
+                jnp.asarray(ids),
+                adapter_idx=np.array([adapter_slot], np.int32),
+            )
             first = self.engine._sample(
                 logits[:, L - 1, :],
                 self._request_key(req, 0),
@@ -484,6 +574,9 @@ class ContinuousBatchingScheduler:
             error=detail,
         )
         self._slots[slot_idx] = None  # evict: slot is free, nothing recompiles
+        self._adapter_row[slot_idx] = 0  # free rows decode the identity adapter
+        self._release_adapter(req)
+        self._count_adapter_request(req)
         if slot.span is not None:
             slot.span.set(
                 finish_reason=reason, output_tokens=len(completion.tokens)
@@ -512,6 +605,7 @@ class ContinuousBatchingScheduler:
     ) -> Completion:
         """A request that never reached a slot (cancelled or expired while
         queued): empty output, zero latency fields."""
+        self._count_adapter_request(req)
         completion = Completion(
             uid=req.uid,
             tokens=[],
@@ -681,6 +775,20 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 self._pending.popleft()
                 finished.append(self._finalize_unadmitted(req, "timeout"))
                 continue
+            try:
+                adapter_slot = self._acquire_adapter(req)
+            except Exception as e:
+                logger.warning(f"request {req.uid}: adapter load failed: {e!r}")
+                self._pending.popleft()
+                finished.append(
+                    self._finalize_unadmitted(req, "error", f"adapter load failed: {e}")
+                )
+                continue
+            if adapter_slot is None:
+                # every adapter slot pinned by live traffic: the head stays
+                # queued (FIFO) and retries after a retirement drops a pin —
+                # the same contract as allocator exhaustion below
+                return
             need = pages_needed(
                 len(req.prompt) + req.max_new_tokens, self.engine.page_size
             )
@@ -699,6 +807,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 # free as decoding requests retire (docs/operations.md)
                 if shared_pages:
                     self.allocator.decref(shared_pages)
+                self._release_adapter(req)  # drop the pin while we wait
                 return
             self._pending.popleft()
             t_admit = time.monotonic()
@@ -714,12 +823,14 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 shared_pages=len(shared_pages),
                 prefill_progress=shared_tokens,
                 seq=self._admit_seq,
+                adapter_slot=adapter_slot,
             )
             self._admit_seq += 1
             # decode row stays NULL until this slot starts decoding
             self._tokens[slot_idx] = 0
             self._positions[slot_idx] = 0
             self._tables[slot_idx, :] = 0
+            self._adapter_row[slot_idx] = adapter_slot
 
     # -- prefill (one chunk per round) ----------------------------------------
 
@@ -754,7 +865,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             "prefill_chunk", trace_id=tid, uid=req.uid, start=start, chunk=chunk
         ):
             logits, self._pool = self.engine.prefill_chunk(
-                jnp.asarray(ids), start, self._ensure_pool(), table
+                jnp.asarray(ids), start, self._ensure_pool(), table,
+                adapter_idx=[slot.adapter_slot],
             )
             slot.prefill_progress = start + n_real
             if slot.prefill_progress >= L:
@@ -861,7 +973,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             temps[slot_idx] = slot.request.temperature
             top_ps[slot_idx] = slot.request.top_p
         logits, self._pool = self.engine.verify_paged(
-            self._ensure_pool(), tokens, positions, tables
+            self._ensure_pool(), tokens, positions, tables,
+            adapter_idx=self._adapter_row,
         )
         accept, alt = self._spec_sample(
             logits,
@@ -946,6 +1059,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                     jnp.asarray(self._tokens)[:, None],
                     jnp.asarray(self._positions)[:, None],
                     self._tables,
+                    adapter_idx=self._adapter_row,
                 )
                 self._step_count += 1
                 masked = [
@@ -988,6 +1102,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 self._positions[slot_idx] = slot.pos
                 self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
                 self._finish_if_done(slot_idx, finished)
+        record = None
         if self.metrics is not None:
             watcher = getattr(self.engine, "compile_watcher", None)
             record = {
@@ -1013,6 +1128,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 record["serve/spec_accept_rate"] = round(
                     self._spec_accepted / max(self._spec_drafted, 1), 4
                 )
+        self._adapter_gauges(record)
+        if record is not None:
             self.metrics.log(record)
         return finished
 

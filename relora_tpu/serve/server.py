@@ -142,6 +142,12 @@ def parse_generate_body(
     spec = payload.get("spec", True)
     if not isinstance(spec, bool):
         raise BadRequest('"spec" must be a boolean')
+    # multi-tenant: "adapter" names a LoRA adapter dir under --adapter-dir;
+    # absent/null decodes the base model.  Whether the name is servable is
+    # the scheduler's call (validate_request -> registry.known)
+    adapter = payload.get("adapter")
+    if adapter is not None and (not isinstance(adapter, str) or not adapter.strip()):
+        raise BadRequest('"adapter" must be a non-empty string')
     return {
         "prompt": prompt,
         "max_new_tokens": max_new,
@@ -150,6 +156,7 @@ def parse_generate_body(
         "stream": stream,
         "deadline_s": deadline_s,
         "spec": spec,
+        "adapter": adapter.strip() if isinstance(adapter, str) else None,
     }
 
 
@@ -206,6 +213,20 @@ class GenerateServer:
             scheduler.tracer = self.tracer
         if scheduler.obs_registry is None:
             scheduler.obs_registry = self.stats
+        # multi-tenant: materialize the per-adapter series at zero so a
+        # scrape taken before any tenant traffic still shows every adapter
+        # the server can route to (absent-vs-zero is a real distinction for
+        # dashboards doing rate() over counters)
+        registry = getattr(scheduler, "adapter_registry", None)
+        if registry is not None:
+            if registry.metrics is None:
+                registry.metrics = self.stats  # evictions counter + load histogram
+            self.stats.inc("adapter_requests_total", ("adapter", "base"), 0)
+            for name in registry.list_adapters():
+                self.stats.inc("adapter_requests_total", ("adapter", name), 0)
+            self.stats.inc("adapter_evictions_total", by=0)
+            self.stats.set_gauge("adapter_slots_used", registry.slots_used())
+            self.stats.materialize_histogram("adapter_load_seconds")
         self.default_max_new_tokens = default_max_new_tokens
         self.default_temperature = default_temperature
         self.default_top_p = default_top_p
@@ -578,6 +599,13 @@ class GenerateServer:
         paging_stats = getattr(self.scheduler, "paging_stats", None)
         if paging_stats is not None:
             payload["paging"] = paging_stats()
+        # multi-tenant scheduler: slot occupancy + residency for the
+        # adapter-slot-thrash triage flow (docs/operations.md)
+        adapter_stats = getattr(self.scheduler, "adapter_stats", None)
+        if adapter_stats is not None:
+            stats = adapter_stats()
+            if stats is not None:
+                payload["adapters"] = stats
         await _respond_json(writer, status, payload)
 
     async def _handle_generate(
@@ -617,6 +645,7 @@ class GenerateServer:
                 temperature=fields["temperature"],
                 top_p=fields["top_p"],
                 spec=fields["spec"],
+                adapter=fields["adapter"],
             )
             # capacity/validity errors surface as 400 here, before admission,
             # instead of crashing the decode loop later

@@ -296,6 +296,82 @@ wait "$SPEC_PID"
 grep -q "serve/spec_drafted_total" "$WORK/spec_run/metrics.jsonl"
 grep -q "serve/spec_accept_rate" "$WORK/spec_run/metrics.jsonl"
 
+echo "=== 9e. multi-tenant adapter serving (pytest -m adapters, then the CLI drill) ==="
+# the compile-heavy multi-tenant integration tests (per-tenant token parity
+# on both model families, scheduler contention, churn-no-retrace, HTTP end
+# to end) are slow-marked out of tier-1 and run here, like stage 6b
+python -m pytest tests/test_adapters.py -q -m "adapters and slow" -p no:cacheprovider
+# tenant A: a short hot-lr continuation of run 2, saved MID-cycle (step 44;
+# resets land on 40/48) so its factors are nonzero and actually steer greedy
+# decode — checkpoints at reset boundaries (model_8..model_40) have freshly
+# reinitialized factors whose contribution is exactly zero.  tenant B is one
+# of those boundary checkpoints: a valid, loadable identity-contribution
+# adapter that must reproduce the base stream.
+python main.py --megatron_dataset_config "$WORK/mega.yaml" --model_config llama_9m \
+    --batch_size 4 --total_batch_size 8 --max_length 32 --dp_size 2 \
+    --warmup_steps 2 --eval_every 1000 --seed 1 \
+    --lr 0.1 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --warmed_up_model "$WORK/relora/model_40" \
+    --num_training_steps 48 --save_every 4 --save_dir "$WORK/tenant_a"
+mkdir -p "$WORK/adapters"
+ln -sfn "$WORK/tenant_a/model_44" "$WORK/adapters/tA"
+ln -sfn "$WORK/relora/model_16" "$WORK/adapters/tB"
+rm -f "$WORK/adapter_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/adapter_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 8 --eos-id -1 \
+    --no-merge --adapter-dir "$WORK/adapters" --adapters tA,tB --adapter-slots 3 \
+    --run-dir "$WORK/adapter_run" &
+ADPT_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/adapter_port" ] && break; sleep 0.2; done
+[ -s "$WORK/adapter_port" ] || { echo "adapter server never wrote its port"; kill "$ADPT_PID"; exit 1; }
+python - "$(cat "$WORK/adapter_port")" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+assert health["status"] == "ok", health
+adapters = health["adapters"]
+assert adapters["num_slots"] == 3, adapters
+assert set(adapters["resident"]) == {"tA", "tB"}, adapters
+
+def generate(adapter=None):
+    body = {"prompt": [(i % 50) + 1 for i in range(12)], "max_new_tokens": 8}
+    if adapter is not None:
+        body["adapter"] = adapter
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate", data=json.dumps(body).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+    final = json.loads(events[-2])
+    assert final["finish_reason"] == "length" and len(final["tokens"]) == 8, final
+    return final["tokens"]
+
+base, ta, tb = generate(), generate("tA"), generate("tB")
+# tenant A's hot-lr factors must steer greedy decode away from the base;
+# tenant B's boundary-checkpoint factors contribute zero and must not
+assert ta != base, f"tenant stream identical to base: {ta}"
+assert tb == base, f"identity-factor tenant diverged from base: {tb}"
+# greedy + resident slot: the same tenant must decode deterministically
+assert generate("tA") == ta, "tenant decode not deterministic"
+metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+for want in (
+    'relora_serve_adapter_requests_total{adapter="base"} 1',
+    'relora_serve_adapter_requests_total{adapter="tA"} 2',
+    'relora_serve_adapter_requests_total{adapter="tB"} 1',
+    "relora_serve_adapter_slots_used 3",
+    "relora_serve_adapter_evictions_total 0",
+    "relora_serve_adapter_load_seconds_count 0",  # preloads; zero runtime loads
+):
+    assert want in metrics, f"missing from /metrics: {want}"
+print("multi-tenant HTTP OK: base", base, "| tA", ta, "| tB", tb)
+EOF
+kill -TERM "$ADPT_PID"
+wait "$ADPT_PID"
+grep -q "serve/adapter_slots_used" "$WORK/adapter_run/metrics.jsonl"
+grep -q "serve/adapter_hit_rate" "$WORK/adapter_run/metrics.jsonl"
+
 echo "=== 10. traced run + SIGTERM flight dump (obs subsystem) ==="
 # fault injection fires a real SIGTERM at update 4; the PreemptionGuard
 # handler dumps the span flight recorder before the emergency checkpoint

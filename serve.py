@@ -141,6 +141,29 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="serve LoRA factors unmerged (quantized bases / adapter hot-swap); "
         "the decode forward routes the composite through ops/lora_dispatch",
     )
+    p.add_argument(
+        "--adapter-dir",
+        default=None,
+        help="multi-tenant serving: directory of unmerged adapter checkpoint "
+        "dirs (one subdir per tenant, each with a relora_config.json "
+        'sidecar); requests pick one via the "adapter" body field and decode '
+        "through the grouped per-row LoRA kernel (docs/serving.md); "
+        "requires --no-merge",
+    )
+    p.add_argument(
+        "--adapters",
+        default=None,
+        help="comma-separated adapter names to preload into slots at startup "
+        "(warm tenants skip the first-request load stall); requires "
+        "--adapter-dir",
+    )
+    p.add_argument(
+        "--adapter-slots",
+        type=int,
+        default=None,
+        help="HBM adapter slot pool size, including the reserved identity "
+        "slot 0 (default 4); requires --adapter-dir",
+    )
     return p.parse_args(argv)
 
 
@@ -188,6 +211,28 @@ def main(argv=None) -> int:
         )
     if args.port is not None and (args.prompt or args.input_file):
         raise SystemExit("--port runs the HTTP server; drop --prompt/--input-file")
+    if args.adapter_dir is not None and not args.no_merge:
+        raise SystemExit(
+            "--adapter-dir requires --no-merge (tenant adapters hot-swap "
+            "against an unmerged base; a merged checkpoint has no LoRA slots)"
+        )
+    if args.adapters is not None and args.adapter_dir is None:
+        raise SystemExit(
+            "--adapters preloads tenant adapters and requires --adapter-dir"
+        )
+    if args.adapter_slots is not None:
+        if args.adapter_dir is None:
+            raise SystemExit(
+                "--adapter-slots sizes the tenant slot pool and requires "
+                "--adapter-dir"
+            )
+        if args.adapter_slots < 2:
+            raise SystemExit(
+                f"--adapter-slots must be >= 2 (slot 0 is the reserved "
+                f"identity adapter), got {args.adapter_slots}"
+            )
+    if args.adapter_dir is not None and not os.path.isdir(args.adapter_dir):
+        raise SystemExit(f"--adapter-dir {args.adapter_dir} is not a directory")
 
     tokenizer = None
     if args.tokenizer:
@@ -279,6 +324,7 @@ def main(argv=None) -> int:
             devices=jax.devices()[: args.tp],
         )
         logger.info(f"tensor-parallel serving over {args.tp} devices")
+    adapter_slots = (args.adapter_slots or 4) if args.adapter_dir else 0
     engine = InferenceEngine(
         model_cfg,
         params,
@@ -287,9 +333,26 @@ def main(argv=None) -> int:
         scan_layers=not args.no_scan,
         lora=lora_spec,
         mesh=mesh,
+        adapter_slots=adapter_slots,
         **paged_kwargs,
     )
     key = jax.random.PRNGKey(args.seed)
+
+    adapter_registry = None
+    if args.adapter_dir:
+        from relora_tpu.serve.adapters import AdapterRegistry
+
+        adapter_registry = AdapterRegistry(
+            args.adapter_dir,
+            adapter_slots,
+            expected_r=lora_spec.r,
+            writer=engine.adapter_writer(),
+        )
+        names = adapter_registry.list_adapters()
+        logger.info(
+            f"adapter registry: {adapter_slots} slots over {args.adapter_dir} "
+            f"({len(names)} adapters: {', '.join(names) or 'none'})"
+        )
 
     def build_scheduler(metrics):
         from relora_tpu.serve.scheduler import (
@@ -303,6 +366,7 @@ def main(argv=None) -> int:
             top_k=args.top_k,
             metrics=metrics,
             key=key,
+            adapter_registry=adapter_registry,
         )
         if args.paged:
             return PagedContinuousBatchingScheduler(
@@ -346,6 +410,17 @@ def main(argv=None) -> int:
                     prompt_buckets=report["prompt_buckets"],
                     n_compiles=report["n_compiles"],
                 )
+        # preload AFTER warmup: the warmup pass writes a zero adapter into
+        # the last slot to compile the slot-write program, which would
+        # clobber a preloaded tenant if it ran second
+        if adapter_registry is not None and args.adapters:
+            for name in [n.strip() for n in args.adapters.split(",") if n.strip()]:
+                try:
+                    slot = adapter_registry.acquire(name)
+                except ValueError as e:
+                    raise SystemExit(f"--adapters: {e}")
+                adapter_registry.release(name)
+                logger.info(f"preloaded adapter {name!r} into slot {slot}")
         scheduler = build_scheduler(metrics)
 
         def ready(server):

@@ -1,0 +1,775 @@
+"""Multi-tenant LoRA serving tests: grouped kernel, registry, token parity.
+
+The acceptance invariant, pinned at every layer: a batch row decoding
+through adapter slot ``j`` produces exactly the tokens a single-adapter
+``--no-merge`` engine holding that adapter's factors produces for the same
+prompt (greedy), for both model families — multi-tenancy changes batch
+composition, never numerics.  Plus:
+
+- grouped-kernel differential: all-rows-one-adapter equals the fused
+  single-adapter kernel bitwise in f32; a mixed-idx batch equals a per-row
+  fused loop;
+- AdapterRegistry refcounted-LRU properties (jax-free);
+- zero steady-state retraces while adapters load/evict/swap mid-traffic
+  (CompileWatcher asserts);
+- the HTTP front-end: ``"adapter"`` body field end to end, per-adapter
+  metrics materialized at zero, /healthz slot stats;
+- serve.py flag validation (--adapter-dir/--adapters/--adapter-slots).
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import LoraSpec
+from relora_tpu.models.params_util import init_params
+from relora_tpu.ops.lora_dispatch import (
+    ARMS,
+    GROUPED_ARMS,
+    choose_grouped_arm,
+    estimate_grouped_arm_times,
+    lora_matmul_grouped,
+)
+from relora_tpu.ops.pallas_lora_matmul import (
+    fused_lora_matmul,
+    grouped_lora_matmul,
+    grouped_lora_reference,
+)
+from relora_tpu.ops.quant import quantize_int8
+from relora_tpu.serve.adapters import (
+    BASE_ADAPTER,
+    RELORA_CONFIG_FILE,
+    AdapterRegistry,
+    extract_lora_factors,
+)
+from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+from relora_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    PagedContinuousBatchingScheduler,
+    Request,
+)
+
+# compile-heavy integration tests (engine/scheduler/HTTP parity, churn
+# retrace guard) carry @pytest.mark.slow and run from smoke stage 9e, like
+# the parallel-composition suite; the kernel/registry/router/collector
+# logic tests stay in tier-1
+pytestmark = pytest.mark.adapters
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_LLAMA = ModelConfig(
+    family="llama",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+)
+TINY_NEOX = ModelConfig(
+    family="neox",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+    rotary_pct=0.25,
+)
+
+FAMILIES = [
+    pytest.param(TINY_LLAMA, id="llama"),
+    pytest.param(TINY_NEOX, id="pythia"),
+]
+
+SPEC = LoraSpec(r=4, alpha=8)
+
+
+# -- grouped-kernel differential ----------------------------------------------
+
+
+def _grouped_operands(seed=0, M=6, K=32, N=128, r=4, S=3):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    x = jax.random.normal(ks[0], (M, K), jnp.float32)
+    w = jax.random.normal(ks[1], (K, N), jnp.float32) * 0.1
+    a = jax.random.normal(ks[2], (S, K, r), jnp.float32) * 0.1
+    b = jax.random.normal(ks[3], (S, r, N), jnp.float32) * 0.1
+    s = jnp.asarray([0.0, 2.0, 0.5], jnp.float32)
+    idx = jnp.asarray([0, 1, 2, 1, 0, 2], jnp.int32)
+    return x, w, a, b, s, idx
+
+
+def test_grouped_all_rows_one_adapter_matches_fused_bitwise():
+    """Every row on the same slot: the grouped kernel must reproduce the
+    single-adapter fused kernel *bitwise* in f32 — same contraction shapes,
+    same accumulation order, just a prefetch-steered factor fetch."""
+    x, w, a, b, s, _ = _grouped_operands()
+    for j in range(a.shape[0]):
+        idx = jnp.full((x.shape[0],), j, jnp.int32)
+        got = grouped_lora_matmul(x, w, a, b, s, idx, interpret=True)
+        want = fused_lora_matmul(
+            x, w, a[j], b[j], float(s[j]), block_m=1, block_n=128, interpret=True
+        )
+        assert np.array_equal(np.asarray(got), np.asarray(want)), f"slot {j}"
+
+
+def test_grouped_mixed_idx_matches_per_row_fused_loop():
+    """A mixed-tenant batch equals running each row alone through the fused
+    kernel with its own adapter — the per-row slot routing is exact."""
+    x, w, a, b, s, idx = _grouped_operands()
+    got = np.asarray(grouped_lora_matmul(x, w, a, b, s, idx, interpret=True))
+    for m in range(x.shape[0]):
+        j = int(idx[m])
+        row = fused_lora_matmul(
+            x[m : m + 1], w, a[j], b[j], float(s[j]),
+            block_m=1, block_n=128, interpret=True,
+        )
+        assert np.array_equal(got[m : m + 1], np.asarray(row)), f"row {m}"
+
+
+def test_grouped_reference_matches_kernel():
+    x, w, a, b, s, idx = _grouped_operands()
+    got = grouped_lora_matmul(x, w, a, b, s, idx, interpret=True)
+    want = grouped_lora_reference(x, w, a, b, s, idx)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_grouped_slot_zero_is_identity():
+    """Rows on slot 0 (zero factors) decode the pure base matmul."""
+    x, w, a, b, s, _ = _grouped_operands()
+    a = a.at[0].set(0.0)
+    b = b.at[0].set(0.0)
+    idx = jnp.zeros((x.shape[0],), jnp.int32)
+    got = grouped_lora_matmul(x, w, a, b, s, idx, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w), atol=1e-5)
+
+
+def test_grouped_validation_errors():
+    x, w, a, b, s, idx = _grouped_operands()
+    with pytest.raises(ValueError, match="contraction mismatch"):
+        grouped_lora_matmul(x[:, :16], w, a, b, s, idx, interpret=True)
+    with pytest.raises(ValueError, match="B stack"):
+        grouped_lora_matmul(x, w, a, b[:, :, :64], s, idx, interpret=True)
+    with pytest.raises(ValueError, match="adapter_idx"):
+        grouped_lora_matmul(x, w, a, b, s, idx[:3], interpret=True)
+    with pytest.raises(ValueError, match="unknown grouped arm"):
+        lora_matmul_grouped(x, w, a, b, s, idx, arm="fused")
+
+
+def test_grouped_arm_vocabulary_disjoint_from_single_adapter_arms():
+    """The grouped dispatcher has its own arm vocabulary; the single-adapter
+    ``ARMS`` tuple (pinned by test_lora_kernels) is untouched."""
+    assert set(GROUPED_ARMS) == {"grouped", "gathered", "looped"}
+    assert not set(GROUPED_ARMS) & set(ARMS)
+    times = estimate_grouped_arm_times(256, 64, 128, 4, num_adapters=2)
+    assert set(times) == set(GROUPED_ARMS)
+    assert all(t > 0 for t in times.values())
+
+
+def test_grouped_cost_model_scales_with_distinct_adapters():
+    """The grouped arm's modeled bytes scale with the *distinct* adapters a
+    batch touches (G), not the batch size — the property the kernel exists
+    for — so its estimate grows with G and beats the M-scaling gather for
+    large batches over few tenants."""
+    M, K, N, r = 4096, 1024, 1024, 16
+    few = estimate_grouped_arm_times(M, K, N, r, num_adapters=2)
+    many = estimate_grouped_arm_times(M, K, N, r, num_adapters=64)
+    assert few["grouped"] <= many["grouped"]
+    assert few["grouped"] < few["gathered"]
+    # the G-launch loop loses once it re-reads W per adapter
+    assert many["looped"] > many["grouped"]
+    # off-TPU / int8 / untileable N: both kernel arms struck
+    assert choose_grouped_arm(M, K, N, r, 2, grouped_available=False) == "gathered"
+    assert choose_grouped_arm(M, K, 130, r, 2) == "gathered"
+
+
+@pytest.mark.parametrize("arm", ["gathered", "grouped", "looped"])
+def test_lora_matmul_grouped_numerics_arm_independent(arm):
+    x, w, a, b, s, idx = _grouped_operands()
+    want = grouped_lora_reference(x, w, a, b, s, idx)
+    got = lora_matmul_grouped(x, w, a, b, s, idx, arm=arm)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_lora_matmul_grouped_int8_base_takes_reference():
+    x, w, a, b, s, idx = _grouped_operands()
+    q, qscale = quantize_int8(w)
+    got = lora_matmul_grouped(x, (q, qscale), a, b, s, idx, arm="auto")
+    want = grouped_lora_reference(x, w, a, b, s, idx)
+    # int8 dequant noise dominates; the shape/path must still be right
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0.15)
+
+
+# -- AdapterRegistry: refcounted LRU properties (jax-free) --------------------
+
+
+def _fake_adapter_dir(tmp_path, names):
+    root = tmp_path / "adapters"
+    for name in names:
+        d = root / name
+        d.mkdir(parents=True)
+        (d / RELORA_CONFIG_FILE).write_text(json.dumps({"r": 4, "alpha": 8}))
+    return str(root)
+
+
+def _registry(tmp_path, names=("tA", "tB", "tC"), num_slots=3, writer=None):
+    writes = []
+
+    def record(slot, factors, scale):
+        writes.append((slot, factors, scale))
+        if writer is not None:
+            writer(slot, factors, scale)
+
+    reg = AdapterRegistry(
+        _fake_adapter_dir(tmp_path, names),
+        num_slots,
+        writer=record,
+        loader=lambda path, r: ({"dense": {"lora_a": os.path.basename(path)}}, 2.0),
+    )
+    return reg, writes
+
+
+def test_registry_identity_slot_and_validation(tmp_path):
+    reg, writes = _registry(tmp_path)
+    assert reg.acquire(None) == 0
+    assert reg.acquire(BASE_ADAPTER) == 0
+    reg.release(None)  # no-op, never raises
+    reg.release(BASE_ADAPTER)
+    assert not writes  # slot 0 is never written
+    assert reg.known(BASE_ADAPTER) and reg.known("tA") and not reg.known("nope")
+    assert reg.list_adapters() == ["tA", "tB", "tC"]
+    with pytest.raises(ValueError, match="num_slots must be >= 2"):
+        AdapterRegistry(None, 1)
+    with pytest.raises(ValueError, match="reserved"):
+        reg.preload(BASE_ADAPTER, {}, 1.0)
+
+
+def test_registry_load_hit_refcount_and_release(tmp_path):
+    reg, writes = _registry(tmp_path)
+    s1 = reg.acquire("tA")
+    assert s1 == 1 and reg.misses_total == 1 and reg.loads_total == 1
+    assert writes[-1][0] == 1 and writes[-1][2] == 2.0
+    assert reg.acquire("tA") == s1  # hit: same slot, no new load
+    assert reg.hits_total == 1 and reg.loads_total == 1
+    assert reg.stats()["resident"]["tA"]["refs"] == 2
+    reg.release("tA")
+    reg.release("tA")
+    assert reg.stats()["resident"]["tA"]["refs"] == 0
+    with pytest.raises(ValueError, match="no active requests"):
+        reg.release("tA")
+    assert reg.slot_of("tA") == s1  # stays warm after release
+
+
+def test_registry_lru_eviction_skips_pinned(tmp_path):
+    reg, _ = _registry(tmp_path, num_slots=3)  # 2 loadable slots
+    reg.acquire("tA")
+    reg.acquire("tB")
+    # both pinned: a third tenant cannot be admitted -> stay queued
+    assert reg.acquire("tC") is None and reg.evictions_total == 0
+    reg.release("tA")  # tA unpinned AND least-recently-used -> the victim
+    assert reg.acquire("tC") == 1 and reg.evictions_total == 1
+    assert reg.slot_of("tA") is None and reg.slot_of("tB") == 2
+    # a hit refreshes recency: tB becomes MRU, tC is now the LRU victim
+    reg.release("tB")
+    reg.release("tC")
+    reg.acquire("tB")
+    reg.release("tB")
+    assert reg.acquire("tA") == 1  # tC's old slot: tC was the LRU victim
+    assert reg.evictions_total == 2
+    assert reg.slot_of("tC") is None and reg.slot_of("tB") == 2
+
+
+def test_registry_failed_load_keeps_slot_clean(tmp_path):
+    calls = {"n": 0}
+
+    def flaky(path, r):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("corrupt checkpoint")
+        return {"dense": {"lora_a": "ok"}}, 1.0
+
+    reg = AdapterRegistry(
+        _fake_adapter_dir(tmp_path, ["tA"]), 2, loader=flaky
+    )
+    with pytest.raises(ValueError, match="corrupt"):
+        reg.acquire("tA")
+    assert reg.slot_of("tA") is None and reg.stats()["slots_free"] == 1
+    assert reg.acquire("tA") == 1  # the slot was returned to the free list
+    with pytest.raises(ValueError, match="unknown adapter"):
+        reg.acquire("missing")
+
+
+def test_registry_preload_and_stats(tmp_path):
+    reg, writes = _registry(tmp_path, num_slots=4)
+    assert reg.preload("warm", {"dense": {"lora_a": 1}}, 0.5) == 1
+    assert reg.preload("warm", {}, 0.5) == 1  # idempotent
+    assert writes[-1][0] == 1 and writes[-1][2] == 0.5
+    assert reg.known("warm")  # resident without a checkpoint dir
+    stats = reg.stats()
+    assert stats["num_slots"] == 4 and stats["slots_used"] == 2
+    assert stats["resident"]["warm"] == {"slot": 1, "refs": 0}
+    reg.acquire("tA")
+    reg.acquire("tA")
+    reg.release("tA")
+    assert reg.stats()["hit_rate"] == 0.5
+
+
+# -- engine: slot writes, zero retraces, per-family token parity --------------
+
+
+def _perturbed(params, leaf, seed):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, t: (
+            jax.random.normal(
+                jax.random.fold_in(
+                    jax.random.PRNGKey(seed),
+                    abs(hash(jax.tree_util.keystr(path))) % (2**31),
+                ),
+                t.shape,
+                t.dtype,
+            )
+            * 0.1
+            if any(getattr(k, "key", None) in leaf for k in path)
+            else t
+        ),
+        params,
+    )
+
+
+def _lora_raw(cfg, seed=0):
+    model = build_decode_model(cfg, cache_size=32, lora=SPEC)
+    return init_params(model, jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cfg", FAMILIES)
+def test_multi_tenant_rows_match_single_adapter_engines(cfg):
+    """THE acceptance invariant (greedy, both families): each row of a
+    mixed-tenant batch reproduces a single-adapter --no-merge engine holding
+    that row's factors; slot-0 rows reproduce the base model."""
+    raw = _lora_raw(cfg)
+    raw_a = _perturbed(raw, ("lora_a", "lora_b"), seed=11)
+    raw_b = _perturbed(raw, ("lora_a", "lora_b"), seed=22)
+    multi = InferenceEngine(cfg, raw, cache_size=32, lora=SPEC, adapter_slots=3)
+    multi.write_adapter_slot(1, extract_lora_factors(raw_a), SPEC.scale)
+    multi.write_adapter_slot(2, extract_lora_factors(raw_b), SPEC.scale)
+
+    prompts = [[1, 2, 3], [1, 2, 3], [1, 2, 3], [9, 8]]
+    tokens = multi.generate(prompts, max_new_tokens=5, adapter_idx=[0, 1, 2, 1])
+
+    solo_base = InferenceEngine(cfg, raw, cache_size=32, lora=SPEC)
+    solo_a = InferenceEngine(cfg, raw_a, cache_size=32, lora=SPEC)
+    solo_b = InferenceEngine(cfg, raw_b, cache_size=32, lora=SPEC)
+    assert tokens[0] == solo_base.generate([prompts[0]], max_new_tokens=5)[0]
+    assert tokens[1] == solo_a.generate([prompts[1]], max_new_tokens=5)[0]
+    assert tokens[2] == solo_b.generate([prompts[2]], max_new_tokens=5)[0]
+    assert tokens[3] == solo_a.generate([prompts[3]], max_new_tokens=5)[0]
+    # the adapters actually steer: tenant rows diverge from base
+    assert tokens[1] != tokens[0]
+
+
+@pytest.mark.slow
+def test_adapter_churn_causes_zero_steady_state_retraces():
+    """Load/evict/swap mid-traffic is pure data movement: after warmup, any
+    number of slot writes and mixed-idx steps adds zero compiles."""
+    raw = _lora_raw(TINY_LLAMA)
+    engine = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=3
+    )
+    report = engine.warmup(2)
+    assert "adapter_write" in {c["fn"] for c in report["compiles"]}
+    prompts = [[1, 2, 3], [4, 5]]
+    engine.generate(prompts, max_new_tokens=4, adapter_idx=[0, 1])
+    cw = engine.compile_watcher
+    baseline = cw.steady_state_retraces
+    # churn: load two tenants, swap one slot's contents twice, decode mixed
+    for seed in (1, 2, 3, 4):
+        factors = extract_lora_factors(_perturbed(raw, ("lora_a", "lora_b"), seed))
+        engine.write_adapter_slot(1 + seed % 2, factors, SPEC.scale)
+        engine.generate(prompts, max_new_tokens=4, adapter_idx=[seed % 3, 1])
+    assert cw.steady_state_retraces == baseline, [
+        (e.fn, e.reason) for e in cw.compile_events() if not e.expected
+    ]
+
+
+@pytest.mark.slow
+def test_engine_adapter_validation():
+    raw = _lora_raw(TINY_LLAMA)
+    with pytest.raises(ValueError, match="adapter_slots"):
+        InferenceEngine(TINY_LLAMA, raw, cache_size=32, adapter_slots=3)
+    with pytest.raises(ValueError, match="adapter_slots"):
+        InferenceEngine(TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=1)
+    engine = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=2
+    )
+    factors = extract_lora_factors(_perturbed(raw, ("lora_a", "lora_b"), 1))
+    with pytest.raises(ValueError, match="slot"):
+        engine.write_adapter_slot(0, factors, 1.0)  # identity slot is immutable
+    with pytest.raises(ValueError, match="slot"):
+        engine.write_adapter_slot(2, factors, 1.0)  # out of range
+    bad = jax.tree_util.tree_map(lambda t: t[..., :2], factors)
+    with pytest.raises(ValueError, match="shape"):
+        engine.write_adapter_slot(1, bad, 1.0)
+
+
+# -- scheduler: multi-tenant drain parity, admission, eviction ----------------
+
+
+def _tenant_registry(engine, raw, names=("tA", "tB"), num_slots=3):
+    reg = AdapterRegistry(None, num_slots, writer=engine.adapter_writer())
+    for i, name in enumerate(names):
+        factors = extract_lora_factors(
+            _perturbed(raw, ("lora_a", "lora_b"), seed=11 * (i + 1))
+        )
+        reg.preload(name, factors, SPEC.scale)
+    return reg
+
+
+def _drain(scheduler, adapters, prompt=(5, 9, 3), n=5):
+    reqs = [
+        Request(uid=i, prompt=list(prompt), max_new_tokens=n, adapter=a)
+        for i, a in enumerate(adapters)
+    ]
+    done = scheduler.run(reqs)
+    return {uid: c.tokens for uid, c in done.items()}
+
+
+@pytest.mark.slow
+def test_scheduler_multi_tenant_parity_and_validation():
+    raw = _lora_raw(TINY_LLAMA)
+    engine = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=3
+    )
+    reg = _tenant_registry(engine, raw)
+    sched = ContinuousBatchingScheduler(
+        engine, max_batch=3, adapter_registry=reg
+    )
+    mixed = _drain(sched, [None, "tA", "tB"])
+    # each tenant alone reproduces its tokens from the mixed batch
+    for uid, name in ((0, None), (1, "tA"), (2, "tB")):
+        solo = ContinuousBatchingScheduler(
+            engine, max_batch=1, adapter_registry=reg
+        )
+        assert _drain(solo, [name])[0] == mixed[uid], (uid, name)
+    assert mixed[1] != mixed[0] and mixed[2] != mixed[1]
+    # refcounts drained back to zero; adapters stay warm
+    stats = sched.adapter_stats()
+    assert all(v["refs"] == 0 for v in stats["resident"].values())
+    with pytest.raises(ValueError, match="unknown adapter"):
+        sched.validate_request(
+            Request(uid=9, prompt=[1], max_new_tokens=1, adapter="nope")
+        )
+    bare = ContinuousBatchingScheduler(engine, max_batch=1)
+    with pytest.raises(ValueError, match="adapter"):
+        bare.validate_request(
+            Request(uid=9, prompt=[1], max_new_tokens=1, adapter="tA")
+        )
+    with pytest.raises(ValueError, match="engine built with adapter_slots"):
+        ContinuousBatchingScheduler(
+            InferenceEngine(TINY_LLAMA, raw, cache_size=32, lora=SPEC),
+            max_batch=1,
+            adapter_registry=reg,
+        )
+
+
+@pytest.mark.slow
+def test_paged_scheduler_matches_contiguous_multi_tenant():
+    raw = _lora_raw(TINY_LLAMA)
+    contiguous = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=3
+    )
+    reg_c = _tenant_registry(contiguous, raw)
+    got_c = _drain(
+        ContinuousBatchingScheduler(contiguous, max_batch=3, adapter_registry=reg_c),
+        [None, "tA", "tB"],
+    )
+    paged = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=3,
+        page_size=8, num_pages=17, chunk_size=8,
+    )
+    reg_p = _tenant_registry(paged, raw)
+    got_p = _drain(
+        PagedContinuousBatchingScheduler(paged, max_batch=3, adapter_registry=reg_p),
+        [None, "tA", "tB"],
+    )
+    assert got_p == got_c
+
+
+@pytest.mark.slow
+def test_scheduler_slot_contention_evicts_then_retries():
+    """num_slots=2 (one loadable slot), two tenants: the second queues until
+    the first's pin drops, then evicts and completes — exactly one eviction,
+    zero failures."""
+    raw = _lora_raw(TINY_LLAMA)
+    engine = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=2
+    )
+    reg = AdapterRegistry(
+        None, 2, writer=engine.adapter_writer(),
+    )
+    factors = {
+        name: extract_lora_factors(_perturbed(raw, ("lora_a", "lora_b"), seed))
+        for name, seed in (("tA", 11), ("tB", 22))
+    }
+    # loader-backed residency without disk: known() needs residency or a dir,
+    # so preload tA and let tB load through a stub loader on admission
+    reg.preload("tA", factors["tA"], SPEC.scale)
+    reg._loader = lambda path, r: (factors["tB"], SPEC.scale)
+    reg.adapter_path = lambda name: name if name in factors else None
+    sched = ContinuousBatchingScheduler(engine, max_batch=2, adapter_registry=reg)
+    done = _drain(sched, ["tA", "tB"])
+    assert sorted(done) == [0, 1]
+    assert len(done[0]) == 5 and len(done[1]) == 5
+    assert reg.evictions_total == 1  # tA evicted once its request retired
+    assert reg.slot_of("tB") == 1 and reg.slot_of("tA") is None
+    # parity survives the eviction dance
+    solo = ContinuousBatchingScheduler(engine, max_batch=1, adapter_registry=reg)
+    assert _drain(solo, ["tB"])[0] == done[1]
+
+
+# -- server: the "adapter" body field end to end ------------------------------
+
+
+def test_parse_generate_body_adapter_field():
+    from relora_tpu.serve.server import BadRequest, parse_generate_body
+
+    kw = dict(default_max_new_tokens=4, default_temperature=0.0, default_top_p=1.0)
+    assert parse_generate_body(json.dumps({"prompt": [1]}).encode(), **kw)[
+        "adapter"
+    ] is None
+    assert (
+        parse_generate_body(
+            json.dumps({"prompt": [1], "adapter": " tA "}).encode(), **kw
+        )["adapter"]
+        == "tA"
+    )
+    for bad in ("", "   ", 5, False, ["tA"]):
+        with pytest.raises(BadRequest, match="adapter"):
+            parse_generate_body(
+                json.dumps({"prompt": [1], "adapter": bad}).encode(), **kw
+            )
+
+
+@pytest.mark.slow
+def test_http_two_adapter_server_matches_single_adapter_runs(tmp_path):
+    """End to end over HTTP: a 2-adapter server returns, per tenant, exactly
+    the tokens of a single-adapter --no-merge run; /metrics materializes the
+    per-adapter series at zero and /healthz carries slot stats."""
+    import socket
+    import threading
+
+    from relora_tpu.serve.server import GenerateServer
+
+    raw = _lora_raw(TINY_LLAMA)
+    engine = InferenceEngine(
+        TINY_LLAMA, raw, cache_size=32, lora=SPEC, adapter_slots=3
+    )
+    raws = {
+        "tA": _perturbed(raw, ("lora_a", "lora_b"), 11),
+        "tB": _perturbed(raw, ("lora_a", "lora_b"), 22),
+    }
+    reg = AdapterRegistry(
+        _fake_adapter_dir(tmp_path, list(raws)), 3, writer=engine.adapter_writer()
+    )
+    for name, tree in raws.items():
+        reg.preload(name, extract_lora_factors(tree), SPEC.scale)
+    scheduler = ContinuousBatchingScheduler(
+        engine, max_batch=2, adapter_registry=reg
+    )
+    server = GenerateServer(scheduler, port=0, max_queue=4)
+
+    import asyncio
+
+    thread = threading.Thread(
+        target=lambda: asyncio.run(
+            server.serve_forever(install_signal_handlers=False)
+        ),
+        daemon=True,
+    )
+    thread.start()
+    assert server.started.wait(60)
+
+    def post(path, payload):
+        body = json.dumps(payload).encode()
+        req = (
+            f"POST {path} HTTP/1.1\r\nHost: t\r\nContent-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+        with socket.create_connection(("127.0.0.1", server.port), timeout=60) as s:
+            s.sendall(req)
+            data = b""
+            while chunk := s.recv(65536):
+                data += chunk
+        head, _, rest = data.partition(b"\r\n\r\n")
+        return int(head.split(b" ", 2)[1]), rest
+
+    def get(path):
+        req = f"GET {path} HTTP/1.1\r\nHost: t\r\nContent-Length: 0\r\n\r\n".encode()
+        with socket.create_connection(("127.0.0.1", server.port), timeout=60) as s:
+            s.sendall(req)
+            data = b""
+            while chunk := s.recv(65536):
+                data += chunk
+        head, _, rest = data.partition(b"\r\n\r\n")
+        return int(head.split(b" ", 2)[1]), rest
+
+    try:
+        # materialized-at-zero before any traffic
+        status, metrics = get("/metrics")
+        assert status == 200
+        text = metrics.decode()
+        assert 'relora_serve_adapter_requests_total{adapter="base"} 0' in text
+        assert 'relora_serve_adapter_requests_total{adapter="tA"} 0' in text
+        assert 'relora_serve_adapter_requests_total{adapter="tB"} 0' in text
+        assert "relora_serve_adapter_evictions_total 0" in text
+        assert "relora_serve_adapter_load_seconds_count 0" in text
+
+        http_tokens = {}
+        for name in (None, "tA", "tB"):
+            payload = {"prompt": [5, 9, 3], "max_new_tokens": 5, "stream": False}
+            if name:
+                payload["adapter"] = name
+            status, body = post("/v1/generate", payload)
+            assert status == 200, body
+            http_tokens[name] = json.loads(body)["tokens"]
+
+        status, body = post(
+            "/v1/generate",
+            {"prompt": [1], "max_new_tokens": 1, "adapter": "nope", "stream": False},
+        )
+        assert status == 400 and b"unknown adapter" in body
+
+        status, body = get("/healthz")
+        health = json.loads(body)
+        assert health["adapters"]["num_slots"] == 3
+        assert set(health["adapters"]["resident"]) == {"tA", "tB"}
+
+        status, metrics = get("/metrics")
+        text = metrics.decode()
+        assert 'relora_serve_adapter_requests_total{adapter="base"} 1' in text
+        assert 'relora_serve_adapter_requests_total{adapter="tA"} 1' in text
+        assert "relora_serve_adapter_slots_used 3" in text
+    finally:
+        server.begin_drain()
+        thread.join(60)
+    assert not thread.is_alive() and server._worker_error is None
+
+    # the parity half: single-adapter --no-merge engines, same greedy prompt
+    for name, tree in (("tA", raws["tA"]), ("tB", raws["tB"]), (None, raw)):
+        solo = InferenceEngine(TINY_LLAMA, tree, cache_size=32, lora=SPEC)
+        assert http_tokens[name] == solo.generate([[5, 9, 3]], max_new_tokens=5)[0]
+    assert http_tokens["tA"] != http_tokens[None]
+
+
+# -- router tenant affinity ---------------------------------------------------
+
+
+def test_router_affinity_is_sticky_and_falls_back():
+    from relora_tpu.serve.router import Router
+
+    router = Router([("h", 1), ("h", 2), ("h", 3)])
+    router._refresh_endpoints()
+    for st in router.replicas.values():
+        st.healthy = True
+
+    picks = {router._pick(set(), adapter="tenant-7").rid for _ in range(8)}
+    assert len(picks) == 1  # sticky: same replica every time
+    home = picks.pop()
+    tenants = [f"tenant-{i}" for i in range(12)]
+    homes = {t: router._pick(set(), adapter=t).rid for t in tenants}
+    assert len(set(homes.values())) > 1  # tenants spread over the fleet
+
+    # losing one replica re-homes only its own tenants (the rendezvous
+    # property; a mod-hash would reshuffle everyone)
+    router.replicas[home].healthy = False
+    for t in tenants:
+        if homes[t] != home:
+            assert router._pick(set(), adapter=t).rid == homes[t]
+    router.replicas[home].healthy = True
+
+    # home already tried (excluded) -> least-loaded fallback, not a dead end
+    other = router._pick({home}, adapter="tenant-7")
+    assert other is not None and other.rid != home
+    # breaker open on the home -> fallback too
+    router.replicas[home].breaker._open()
+    st = router._pick(set(), adapter="tenant-7")
+    assert st is not None and st.rid != home
+    # no adapter: plain least-loaded routing is unchanged
+    assert router._pick(set()) is not None
+
+
+# -- serve.py flag validation -------------------------------------------------
+
+
+def test_cli_adapter_flag_validation(tmp_path):
+    sys.path.insert(0, ROOT)
+    import serve
+
+    common = [
+        "--model_config", "llama_9m",
+        "--checkpoint", "nowhere",
+        "--prompt", "1 2 3",
+    ]
+    with pytest.raises(SystemExit, match="requires --no-merge"):
+        serve.main(common + ["--adapter-dir", str(tmp_path)])
+    with pytest.raises(SystemExit, match="requires --adapter-dir"):
+        serve.main(common + ["--no-merge", "--adapters", "tA"])
+    with pytest.raises(SystemExit, match="requires --adapter-dir"):
+        serve.main(common + ["--no-merge", "--adapter-slots", "4"])
+    with pytest.raises(SystemExit, match="must be >= 2"):
+        serve.main(
+            common
+            + ["--no-merge", "--adapter-dir", str(tmp_path), "--adapter-slots", "1"]
+        )
+    with pytest.raises(SystemExit, match="not a directory"):
+        serve.main(
+            common + ["--no-merge", "--adapter-dir", str(tmp_path / "missing")]
+        )
+
+
+# -- fleet observability ------------------------------------------------------
+
+
+def test_fleet_collector_derives_adapter_churn():
+    from relora_tpu.obs.fleet import FleetCollector
+
+    coll = FleetCollector(lambda: {})
+    text = (
+        "relora_serve_adapter_evictions_total 4\n"
+        "relora_serve_adapter_slots_used 2\n"
+    )
+    first = {}
+    coll._ingest_metrics("r0", text, first, now=100.0)
+    # first scrape: the lifetime total is not churn (a report rebuilt from
+    # disk must not see the whole run's evictions as one round)
+    assert first["adapter_churn"] == 0.0
+    assert not coll.store.events(kinds=("adapter_thrash",))
+
+    second = {}
+    coll._ingest_metrics(
+        "r0",
+        "relora_serve_adapter_evictions_total 7\n"
+        "relora_serve_adapter_slots_used 2\n",
+        second,
+        now=101.0,
+    )
+    assert second["adapter_churn"] == 3.0  # delta, not total
+    events = coll.store.events(kinds=("adapter_thrash",))
+    assert len(events) == 1  # 3 evictions >= the 2-slot pool: one turnover
+    assert events[0]["evictions"] == 3.0 and events[0]["slots_used"] == 2.0
+
+    third = {}
+    coll._ingest_metrics(
+        "r0",
+        "relora_serve_adapter_evictions_total 8\n"
+        "relora_serve_adapter_slots_used 2\n",
+        third,
+        now=102.0,
+    )
+    assert third["adapter_churn"] == 1.0
+    assert len(coll.store.events(kinds=("adapter_thrash",))) == 1  # no new event

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Seven rules, each skipped gracefully when its input files are absent:
+Eight rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -34,6 +34,13 @@ Seven rules, each skipped gracefully when its input files are absent:
    committed ``spec_accept_rate_floor`` and its effective tok/s within
    ``--tolerance`` of the non-speculative "off" level.  Skipped off-TPU —
    CPU timings and random-token bench prompts carry no speculation signal.
+8. **grouped LoRA** (``BENCH_lora.json`` ``detail.grouped_buckets``): on TPU
+   the grouped multi-tenant arm on a degenerate single-adapter batch
+   (``distinct_adapters == 1``) must stay within ``--tolerance`` of the
+   single-adapter fused arm on the same (B, K, N, r) bucket — the grouped
+   kernel's scalar-prefetch indirection must be ~free when every row hits
+   one slot.  Skipped when the artifact was recorded in interpreter mode
+   (``detail.fused_is_interpret``).
 
 Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
 ``--warn-only`` reports failures but exits 0 — CI uses it off-TPU where the
@@ -293,6 +300,39 @@ def check_spec(
     return failures
 
 
+def check_grouped_lora(bench_dir: str, tolerance: float) -> List[str]:
+    """Grouped multi-tenant LoRA rule over ``detail.grouped_buckets`` in
+    BENCH_lora.json: with every row on one adapter (G=1), the grouped
+    scalar-prefetch kernel must match the single-adapter fused kernel within
+    ``tolerance`` on the same shape — otherwise multi-tenancy taxes
+    single-tenant traffic.  Skipped off-TPU (interpreter timings)."""
+    doc = _load(os.path.join(bench_dir, "BENCH_lora.json"))
+    detail = (doc or {}).get("detail") or {}
+    grouped = detail.get("grouped_buckets") or []
+    if not grouped or detail.get("fused_is_interpret"):
+        return []
+    fused_by_shape = {
+        (row.get("M"), row.get("K"), row.get("N"), row.get("r")): row.get("fused_ms")
+        for row in detail.get("buckets") or []
+    }
+    failures = []
+    for row in grouped:
+        if row.get("distinct_adapters") != 1:
+            continue
+        shape = (row.get("B"), row.get("K"), row.get("N"), row.get("r"))
+        fused = fused_by_shape.get(shape)
+        got = row.get("grouped_ms")
+        if not (isinstance(got, (int, float)) and isinstance(fused, (int, float))):
+            continue
+        if got > fused * (1.0 + tolerance):
+            failures.append(
+                f"grouped lora B={shape[0]} K={shape[1]} N={shape[2]} r={shape[3]}: "
+                f"grouped arm {got:.3f}ms is {(got / fused - 1) * 100:.0f}% slower "
+                f"than single-adapter fused {fused:.3f}ms on a G=1 batch"
+            )
+    return failures
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--check", action="store_true", help="run the gate (the only mode)")
@@ -341,6 +381,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_obs(args.dir)
         + check_attn(args.dir, args.tolerance)
         + check_spec(args.dir, baselines, args.tolerance)
+        + check_grouped_lora(args.dir, args.tolerance)
     )
 
     rounds = real_rounds(args.dir)

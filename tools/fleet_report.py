@@ -44,6 +44,8 @@ _COMPARE_COLUMNS = (
     ("err_rate", "error_rate", 1.0, "{:.3f}"),
     ("tok_per_s", "relora_serve_tokens_generated_total_per_s", 1.0, "{:.1f}"),
     ("spec_acc", "spec_accept_rate", 1.0, "{:.3f}"),
+    ("adpt_churn", "adapter_churn", 1.0, "{:.2f}"),
+    ("adpt_hit", "relora_serve_adapter_hit_rate", 1.0, "{:.3f}"),
 )
 
 _TIMELINE_KINDS = (
@@ -51,6 +53,7 @@ _TIMELINE_KINDS = (
     "group_health_flip",
     "slo_burn_alert",
     "series_anomaly",
+    "adapter_thrash",
 )
 
 

@@ -187,6 +187,7 @@ def print_serving(records: List[Dict[str, Any]], out) -> None:
         f"  max {max(fills) * 100:5.1f}%\n"
         f"  prefill stall   mean {mean(stalls) * 100:5.1f}% of step time\n"
     )
+    _print_adapters(steps, out)
     # paged-KV pool pressure (PagedContinuousBatchingScheduler runs only)
     paged_steps = [r for r in steps if "serve/kv_pages_used" in r]
     if not paged_steps:
@@ -222,6 +223,24 @@ def print_serving(records: List[Dict[str, Any]], out) -> None:
             f"  ({last.get('serve/spec_accepted_total', 0)}/"
             f"{last.get('serve/spec_drafted_total', 0)} drafted tokens accepted)\n"
         )
+
+
+def _print_adapters(steps: List[Dict[str, Any]], out) -> None:
+    """Multi-tenant adapter pressure (--adapter-dir runs only).  Evictions
+    are cumulative and hit rate is lifetime, so the last record carries the
+    run totals; slot occupancy is a gauge worth averaging."""
+    adapter_steps = [r for r in steps if "serve/adapter_slots_used" in r]
+    if not adapter_steps:
+        return
+    used = [r["serve/adapter_slots_used"] for r in adapter_steps]
+    last = adapter_steps[-1]
+    evictions = last.get("serve/adapter_evictions_total", 0)
+    hit_rate = last.get("serve/adapter_hit_rate", 0.0)
+    thrash = "  <- slot thrash: raise --adapter-slots" if evictions > 2 * max(used) else ""
+    out.write(
+        f"  adapter slots   mean {mean(used):5.1f} used  peak {max(used):.0f}\n"
+        f"  adapter churn   {evictions:.0f} evictions  hit rate {hit_rate * 100:5.1f}%{thrash}\n"
+    )
 
 
 def print_phases(trace_path: str, out) -> None:
