@@ -37,9 +37,14 @@ device lands in the artifact for the reader to judge.
 server.py) end to end: boots an in-process server over a randomly initialized
 model, sweeps offered QPS open-loop (uniform arrivals), then saturates it
 closed-loop, and writes throughput, p50/p95 TTFT and TPOT, and rejection rate
-per level to ``BENCH_http.json``.  Env: BENCH_HTTP_MODEL (default llama_9m),
-BENCH_HTTP_MAX_BATCH, BENCH_HTTP_QUEUE, BENCH_HTTP_QPS ("4,16,64"),
-BENCH_HTTP_DURATION, BENCH_HTTP_PROMPT_LEN, BENCH_HTTP_NEW_TOKENS.  Runs on
+per level to ``BENCH_http.json``.  Every paged level also records its
+dispatch economics (dispatches per round, tokens per dispatch, packed token
+utilization, prefill stall share) under ``detail.levels[].dispatch``, and a
+``detail.packed_run`` phase re-drives the load through the single-dispatch
+packed scheduler (``BENCH_HTTP_PACKED_STEP=0`` skips it).  Env:
+BENCH_HTTP_MODEL (default llama_9m), BENCH_HTTP_MAX_BATCH, BENCH_HTTP_QUEUE,
+BENCH_HTTP_QPS ("4,16,64"), BENCH_HTTP_DURATION, BENCH_HTTP_PROMPT_LEN,
+BENCH_HTTP_NEW_TOKENS.  Runs on
 any backend, CPU included — the device lands in the artifact.  With
 ``--router`` it additionally boots a 2-replica subprocess fleet
 (``serve.py --random-init`` under ReplicaSupervisor) behind the
@@ -444,16 +449,21 @@ def serve_load_main(router: bool = False) -> None:
         else ["bf16"]
     )
 
-    def build_stack(kv_dtype: str, spec: str = "off", spec_k: int = 0):
+    def build_stack(kv_dtype: str, spec: str = "off", spec_k: int = 0, packed: bool = False):
         if paged:
             num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
+            # packed mode: budget = every decode window + one chunk of prefill
+            window = (spec_k + 1) if spec != "off" else 1
+            budget = max_batch * window + chunk_size if packed else None
             eng = InferenceEngine(
                 cfg, params, cache_size=cache_size,
                 page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
-                kv_dtype=kv_dtype, spec_k=spec_k,
+                kv_dtype=kv_dtype, spec_k=spec_k, token_budget=budget,
             )
-            eng.warmup(max_batch)
-            sched = PagedContinuousBatchingScheduler(eng, max_batch=max_batch, spec=spec)
+            eng.warmup(max_batch, packed=packed)
+            sched = PagedContinuousBatchingScheduler(
+                eng, max_batch=max_batch, spec=spec, packed=packed
+            )
         else:
             eng = InferenceEngine(cfg, params, cache_size=cache_size)
             buckets = sorted({prompt_len} | ({long_prompt_len} if long_share > 0 else set()))
@@ -613,6 +623,27 @@ def serve_load_main(router: bool = False) -> None:
             stats["prefix_hit_rate"] = round(hits / max(lookups, 1), 4)
         return stats
 
+    def level_dispatch_stats(before: dict) -> dict:
+        """Per-level dispatch economics from counter deltas: how many model
+        dispatches a scheduler round cost, how full each dispatch was, and
+        the share of wall time the level spent stalled on prefill."""
+        after = scheduler.dispatch_stats()
+        rounds = after["rounds"] - before["rounds"]
+        disp = after["model_dispatches"] - before["model_dispatches"]
+        tok = after["tokens_total"] - before["tokens_total"]
+        real = after["tokens_real"] - before["tokens_real"]
+        admit = after["admit_time_s"] - before["admit_time_s"]
+        decode = after["decode_time_s"] - before["decode_time_s"]
+        return {
+            "mode": after["mode"],
+            "rounds": rounds,
+            "model_dispatches": disp,
+            "dispatches_per_round": round(disp / max(rounds, 1), 4),
+            "tokens_per_dispatch": round(tok / max(disp, 1), 4),
+            "packed_token_utilization": round(real / max(tok, 1), 4),
+            "prefill_stall_share": round(admit / max(admit + decode, 1e-9), 4),
+        }
+
     async def run_level(coro) -> dict:
         if not paged:
             return await coro
@@ -621,9 +652,11 @@ def serve_load_main(router: bool = False) -> None:
             "lookups": pc.lookups if pc is not None else 0,
             "hits": pc.hits if pc is not None else 0,
         }
+        before_disp = scheduler.dispatch_stats()
         scheduler.allocator.peak_used = scheduler.allocator.used_pages
         row = await coro
         row["paging"] = level_paging_stats(before)
+        row["dispatch"] = level_dispatch_stats(before_disp)
         return row
 
     async def bench() -> list:
@@ -837,6 +870,24 @@ def serve_load_main(router: bool = False) -> None:
                 kv_dtypes[0], spec=mode, spec_k=int(kstr or "4")
             )
             spec_runs[level] = spec_entry(asyncio.run(bench()), scheduler.spec_stats())
+    # packed single-dispatch run (paged only): same headline kv_dtype and
+    # load levels with the token-budget packed scheduler — the artifact the
+    # gate compares against the sequential headline (TTFT must not regress)
+    packed_run = None
+    if paged and os.environ.get("BENCH_HTTP_PACKED_STEP", "1") != "0":
+        engine, scheduler, server = build_stack(kv_dtypes[0], packed=True)
+        p_rows = asyncio.run(bench())
+        pk = max(p_rows, key=lambda r: r["throughput_tokens_per_s"])
+        packed_run = {
+            "token_budget": engine.token_budget,
+            "buckets": list(engine.packed_buckets()),
+            "peak_throughput_tokens_per_s": pk["throughput_tokens_per_s"],
+            "ttft_p50_ms_at_peak": pk["ttft_p50_ms"],
+            "ttft_p95_ms_at_peak": pk["ttft_p95_ms"],
+            "tpot_p50_ms_at_peak": pk["tpot_p50_ms"],
+            "dispatch": scheduler.dispatch_stats(),
+            "levels": p_rows,
+        }
     # -- multi-tenant adapter sweep -------------------------------------------
     # Each count rebuilds the stack with a lora-enabled engine, an
     # AdapterRegistry preloaded with `count` tenants (distinct factor
@@ -928,6 +979,7 @@ def serve_load_main(router: bool = False) -> None:
                     "kv_dtype": kv_dtypes[0],
                     "kv_dtype_runs": dtype_runs,
                     "spec_runs": spec_runs,
+                    **({"packed_run": packed_run} if packed_run is not None else {}),
                 }
                 if paged
                 else {}

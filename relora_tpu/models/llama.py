@@ -31,7 +31,7 @@ from relora_tpu.config.model import ModelConfig
 from relora_tpu.core.relora import LoraSpec
 from relora_tpu.models.lora import LoRALinear
 from relora_tpu.ops.attention import cached_attention, dot_product_attention
-from relora_tpu.ops.attention_dispatch import paged_attention
+from relora_tpu.ops.attention_dispatch import packed_attention, paged_attention
 
 
 def attend_with_cache(
@@ -76,6 +76,7 @@ def attend_with_paged_cache(
     v_new: jax.Array,
     positions: jax.Array,
     block_tables: jax.Array,
+    row_map: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Paged twin of :func:`attend_with_cache`: K/V pages live in one shared
     pool ("cache" collection, shape (num_pages, page_size, n_kv, head_dim) —
@@ -100,6 +101,13 @@ def attend_with_paged_cache(
     duplicate page indices in one write scatter identical values, so the
     update is well-defined.  Garbage writes can inflate the null page's
     scale — it is only ever read masked, like its codes.
+
+    ``row_map`` (T,) switches to the *packed mixed-batch* layout: B must be
+    1, tokens are laid out token-major and may belong to different requests,
+    ``block_tables`` is the whole (R, W) slot-table matrix, and each token
+    writes and attends through ``block_tables[row_map[t]]`` at its own
+    position (ops/attention_dispatch.packed_attention).  One forward then
+    serves any mix of decode rows, verify windows and prefill chunks.
     """
     B, T = q.shape[:2]
     ps, num_pages = module.page_size, module.num_pages
@@ -107,6 +115,8 @@ def attend_with_paged_cache(
         raise ValueError("paged decode requires num_pages >= 2 (page 0 is the null page)")
     if block_tables is None:
         raise ValueError("paged decode requires block_tables (got None)")
+    if row_map is not None and B != 1:
+        raise ValueError(f"packed (row_map) forward is token-major: B must be 1, got {B}")
     n_kv, hd = k_new.shape[2], k_new.shape[3]
     quantized = getattr(module, "kv_dtype", "bf16") == "int8"
     pool_dtype = jnp.int8 if quantized else k_new.dtype
@@ -115,12 +125,25 @@ def attend_with_paged_cache(
     positions = jnp.broadcast_to(positions, (B, T)).astype(jnp.int32)
     W = block_tables.shape[1]
     logical = jnp.clip(positions // ps, 0, W - 1)
-    rows = jnp.take_along_axis(block_tables, logical, axis=1)  # (B, T) pool pages
+    if row_map is None:
+        rows = jnp.take_along_axis(block_tables, logical, axis=1)  # (B, T) pool pages
+    else:
+        # per-token tables: token t writes through block_tables[row_map[t]]
+        token_tables = jnp.take(
+            block_tables, row_map.reshape(T).astype(jnp.int32), axis=0
+        )  # (T, W)
+        rows = jnp.take_along_axis(
+            token_tables, logical.reshape(T, 1), axis=1
+        ).reshape(B, T)
     offs = positions % ps
 
     if not quantized:
         ck.value = ck.value.at[rows, offs].set(k_new.astype(ck.value.dtype))
         cv.value = cv.value.at[rows, offs].set(v_new.astype(cv.value.dtype))
+        if row_map is not None:
+            return packed_attention(
+                q, ck.value, cv.value, block_tables, row_map, positions
+            )
         return paged_attention(q, ck.value, cv.value, block_tables, positions)
 
     cks = module.variable("cache", "k_scale", jnp.zeros, (num_pages, n_kv), jnp.float32)
@@ -151,6 +174,11 @@ def attend_with_paged_cache(
 
     ck.value, cks.value = write_quantized(ck.value, cks.value, k_new)
     cv.value, cvs.value = write_quantized(cv.value, cvs.value, v_new)
+    if row_map is not None:
+        return packed_attention(
+            q, ck.value, cv.value, block_tables, row_map, positions,
+            k_scale=cks.value, v_scale=cvs.value,
+        )
     return paged_attention(
         q, ck.value, cv.value, block_tables, positions,
         k_scale=cks.value, v_scale=cvs.value,
@@ -255,6 +283,7 @@ class LlamaAttention(nn.Module):
         deterministic: bool = True,
         block_tables: Optional[jax.Array] = None,
         adapter_idx: Optional[jax.Array] = None,
+        row_map: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -276,7 +305,9 @@ class LlamaAttention(nn.Module):
         # the attention impls (no jnp.repeat — the repeat would materialize
         # n/n_kv× the K/V bytes in HBM and ride the ring at full width)
         if self.decode and self.page_size > 0:
-            out = attend_with_paged_cache(self, q, k, v, positions, block_tables)
+            out = attend_with_paged_cache(
+                self, q, k, v, positions, block_tables, row_map
+            )
         elif self.decode:
             out = attend_with_cache(self, q, k, v, positions)
         else:
@@ -325,7 +356,7 @@ class LlamaDecoderLayer(nn.Module):
     kv_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None, row_map=None):
         cfg = self.config
         a = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         a = LlamaAttention(
@@ -333,7 +364,7 @@ class LlamaDecoderLayer(nn.Module):
             self.decode, self.cache_size, self.page_size, self.num_pages,
             self.kv_dtype,
             name="self_attn"
-        )(a, cos, sin, positions, deterministic, block_tables, adapter_idx)
+        )(a, cos, sin, positions, deterministic, block_tables, adapter_idx, row_map)
         x = x + a
         m = RMSNorm(eps=cfg.rms_norm_eps, dtype=self.dtype, name="post_attention_layernorm")(x)
         m = LlamaMLP(cfg, self.lora, self.dtype, name="mlp")(m, deterministic, adapter_idx)
@@ -348,6 +379,7 @@ def decoder_stack(
     input_len: int,
     block_tables: Optional[jax.Array] = None,
     adapter_idx: Optional[jax.Array] = None,
+    row_map: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Shared decoder body: rotary tables + (scanned or unrolled) layers +
     final norm.  Called from inside a parent's @nn.compact, so submodules
@@ -401,17 +433,19 @@ def decoder_stack(
             block,
             variable_axes=variable_axes,
             split_rngs={"params": True, "dropout": True},
-            in_axes=(nn.broadcast,) * 6,
+            in_axes=(nn.broadcast,) * 7,
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
         x, _ = scanned(**layer_kwargs, name="layers")(
-            x, cos, sin, positions, deterministic, block_tables, adapter_idx
+            x, cos, sin, positions, deterministic, block_tables, adapter_idx,
+            row_map,
         )
     else:
         for i in range(cfg.num_hidden_layers):
             x, _ = block(**layer_kwargs, name=f"layers_{i}")(
-                x, cos, sin, positions, deterministic, block_tables, adapter_idx
+                x, cos, sin, positions, deterministic, block_tables, adapter_idx,
+                row_map,
             )
     return RMSNorm(eps=cfg.rms_norm_eps, dtype=module.dtype, name="norm")(x)
 
@@ -469,11 +503,12 @@ class LlamaForCausalLM(nn.Module):
         return_hidden: bool = False,
         block_tables: Optional[jax.Array] = None,
         adapter_idx: Optional[jax.Array] = None,
+        row_map: Optional[jax.Array] = None,
     ) -> jax.Array:
         x = token_embed(self, input_ids)
         x = decoder_stack(
             self, x, positions, deterministic, input_ids.shape[1], block_tables,
-            adapter_idx,
+            adapter_idx, row_map,
         )
         if return_hidden:
             # chunked-CE path: the caller streams the lm_head projection

@@ -77,7 +77,7 @@ class NeoXAttention(nn.Module):
     kv_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None, row_map=None):
         cfg = self.config
         h, n, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
         rot = cfg.rotary_dim
@@ -101,7 +101,7 @@ class NeoXAttention(nn.Module):
         k = jnp.concatenate([apply_rotary(k[..., :rot], cos, sin), k[..., rot:]], axis=-1)
 
         if self.decode and self.page_size > 0:
-            out = attend_with_paged_cache(self, q, k, v, positions, block_tables)
+            out = attend_with_paged_cache(self, q, k, v, positions, block_tables, row_map)
         elif self.decode:
             out = attend_with_cache(self, q, k, v, positions)
         else:
@@ -152,7 +152,7 @@ class NeoXLayer(nn.Module):
     kv_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None):
+    def __call__(self, x, cos, sin, positions=None, deterministic: bool = True, block_tables=None, adapter_idx=None, row_map=None):
         cfg = self.config
         attn_in = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="input_layernorm")(x)
         attn_out = NeoXAttention(
@@ -160,7 +160,7 @@ class NeoXLayer(nn.Module):
             self.decode, self.cache_size, self.page_size, self.num_pages,
             self.kv_dtype,
             name="attention"
-        )(attn_in, cos, sin, positions, deterministic, block_tables, adapter_idx)
+        )(attn_in, cos, sin, positions, deterministic, block_tables, adapter_idx, row_map)
         mlp_in = LayerNorm(
             eps=cfg.layer_norm_eps, dtype=self.dtype, name="post_attention_layernorm"
         )(x if cfg.use_parallel_residual else x + attn_out)
@@ -202,6 +202,7 @@ class GPTNeoXForCausalLM(nn.Module):
         return_hidden: bool = False,
         block_tables: Optional[jax.Array] = None,
         adapter_idx: Optional[jax.Array] = None,
+        row_map: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         x = nn.Embed(
@@ -254,17 +255,17 @@ class GPTNeoXForCausalLM(nn.Module):
                 block,
                 variable_axes=variable_axes,
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast,) * 6,
+                in_axes=(nn.broadcast,) * 7,
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
             x, _ = scanned(**layer_kwargs, name="layers")(
-                x, cos, sin, positions, deterministic, block_tables, adapter_idx
+                x, cos, sin, positions, deterministic, block_tables, adapter_idx, row_map
             )
         else:
             for i in range(cfg.num_hidden_layers):
                 x, _ = block(**layer_kwargs, name=f"layers_{i}")(
-                    x, cos, sin, positions, deterministic, block_tables, adapter_idx
+                    x, cos, sin, positions, deterministic, block_tables, adapter_idx, row_map
                 )
 
         x = LayerNorm(eps=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)

@@ -129,6 +129,7 @@ class CompileWatcher:
         self._expected_depth = 0
         self._expected_reason = "expected"
         self._retraces = 0
+        self._calls: Dict[str, int] = {}
 
     # -- classification -------------------------------------------------------
 
@@ -154,6 +155,13 @@ class CompileWatcher:
 
     def compile_events(self) -> List[CompileEvent]:
         return list(self._events)
+
+    def call_counts(self) -> Dict[str, int]:
+        """Total calls per wrapped function (compiling or warm) — how tests
+        count model dispatches: a scheduler round's dispatch count is the
+        sum of the per-entry deltas across the round."""
+        with self._lock:
+            return dict(self._calls)
 
     def summary(self) -> Dict[str, Any]:
         by_fn: Dict[str, int] = {}
@@ -224,6 +232,9 @@ class _WatchedFunction:
         self._known: set = set()
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        watcher = self._watcher
+        with watcher._lock:
+            watcher._calls[self._name] = watcher._calls.get(self._name, 0) + 1
         treedef, sig = abstract_signature(args, kwargs)
         key = (treedef, sig)
         if key in self._known:

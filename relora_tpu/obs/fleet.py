@@ -431,6 +431,10 @@ class FleetCollector:
         spec_drafted = None
         spec_accepted = None
         evict_delta = None
+        disp_delta = None
+        round_delta = None
+        disp_tokens = None
+        disp_real = None
         for name, value in flat.items():
             values[name] = value
             if name.endswith("_total") or "_total." in name:
@@ -455,6 +459,17 @@ class FleetCollector:
                 # see the whole run's evictions as one giant round
                 elif name.endswith("adapter_evictions_total"):
                     evict_delta = max(0.0, value - prev[1]) if prev is not None else 0.0
+                # packed-dispatch economics from counter deltas: how many
+                # model dispatches a scheduler round costs, and how much of
+                # each packed dispatch was real work vs bucket padding
+                elif name.endswith("model_dispatches_total"):
+                    disp_delta = max(0.0, value - prev[1]) if prev is not None else value
+                elif name.endswith("sched_rounds_total"):
+                    round_delta = max(0.0, value - prev[1]) if prev is not None else value
+                elif name.endswith("dispatch_tokens_real_total"):
+                    disp_real = max(0.0, value - prev[1]) if prev is not None else value
+                elif name.endswith("dispatch_tokens_total"):
+                    disp_tokens = max(0.0, value - prev[1]) if prev is not None else value
             if "group_" in name and name.endswith("_healthy"):
                 prev_g = self._last_gauges.get((source, name))
                 if prev_g is not None and prev_g != value:
@@ -470,6 +485,12 @@ class FleetCollector:
             values["spec_accept_rate"] = (
                 (spec_accepted or 0.0) / spec_drafted if spec_drafted > 0 else 0.0
             )
+        if disp_delta is not None and round_delta is not None and round_delta > 0:
+            values["dispatches_per_round"] = disp_delta / round_delta
+        if disp_tokens is not None and disp_delta is not None and disp_delta > 0:
+            values["tokens_per_dispatch"] = disp_tokens / disp_delta
+        if disp_real is not None and disp_tokens is not None and disp_tokens > 0:
+            values["packed_token_utilization"] = disp_real / disp_tokens
         if evict_delta is not None:
             # per-replica adapter churn: evictions this round.  A round that
             # turns over the whole slot pool means tenants are thrashing each

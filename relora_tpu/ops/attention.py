@@ -439,6 +439,196 @@ def paged_decode_attention(
     return out.reshape(B, N, T, H).transpose(0, 2, 1, 3)
 
 
+# ---------------------------------------------------------------------------
+# Packed mixed-batch kernel: per-token row/position maps over the pool
+# ---------------------------------------------------------------------------
+
+
+def _packed_paged_kernel(
+    # scalar-prefetch operands (SMEM)
+    rm_ref,  # (T,) int32 packed token -> block-table row
+    bt_ref,  # (R, W) int32 block tables, one row per slot (+ null row)
+    pos_ref,  # (T,) int32 per-packed-token absolute positions
+    # VMEM inputs
+    q_ref,  # (1, N, H) this packed token's query, head-major
+    k_ref,  # (1, ps, n_kv, H) pool page selected by bt[rm[t], w]
+    v_ref,  # (1, ps, n_kv, H)
+    ks_ref,  # (1, n_kv) f32 page scales (ones when unquantized)
+    vs_ref,  # (1, n_kv)
+    # VMEM output
+    o_ref,  # (1, N, H)
+    # VMEM scratch, carried across the W grid steps of one token
+    acc_ref,  # (N, H) f32 running numerator
+    m_ref,  # (N, 1) f32 running max
+    l_ref,  # (N, 1) f32 running denominator
+    *,
+    sm_scale: float,
+    page_size: int,
+    n_kv: int,
+    quantized: bool,
+):
+    t = pl.program_id(0)
+    w = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    g = q_ref.shape[1] // n_kv
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # absolute token index of each slot in this page; (1, ps) because TPU
+    # requires >=2D iota.  Visibility is j <= position of THIS packed token —
+    # the only coupling between packed tokens is that none exists: each grid
+    # row walks its own table's pages and masks by its own position, so a
+    # row's output cannot depend on what else shares the dispatch.
+    idx = w * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    visible = jnp.broadcast_to(idx <= pos_ref[t], (g, page_size))
+
+    for j in range(n_kv):
+        kj = k_ref[0, :, j, :].astype(jnp.float32)  # (ps, H)
+        vj = v_ref[0, :, j, :].astype(jnp.float32)
+        if quantized:
+            kj = kj * ks_ref[0, j]
+            vj = vj * vs_ref[0, j]
+        qj = q_ref[0, j * g : (j + 1) * g, :].astype(jnp.float32)  # (g, H)
+        s = (
+            jax.lax.dot_general(
+                qj, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )  # (g, ps)
+        s = jnp.where(visible, s, -1e30)
+
+        m_prev = m_ref[j * g : (j + 1) * g, :]  # (g, 1)
+        l_prev = l_ref[j * g : (j + 1) * g, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)  # (g, 1)
+        # mask p itself, not just the logits: if every slot of a page is
+        # hidden, exp(-1e30 - m) could still round to nonzero garbage
+        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)  # (g, ps)
+        m_ref[j * g : (j + 1) * g, :] = m_new
+        l_ref[j * g : (j + 1) * g, :] = l_prev * alpha + jnp.sum(
+            p, axis=1, keepdims=True
+        )
+        acc_ref[j * g : (j + 1) * g, :] = acc_ref[
+            j * g : (j + 1) * g, :
+        ] * alpha + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(w == n_pages - 1)
+    def _emit():
+        # a fully-masked token (pad rows at the null position with an
+        # all-null table still see page 0 unmasked at pos=cache_size, so l
+        # stays positive) — but guard the division anyway: garbage rows must
+        # stay finite so they cannot poison reductions downstream
+        o_ref[0, :, :] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def packed_paged_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    row_map: jax.Array,
+    positions: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention for a *packed* mixed batch straight out of the pool.
+
+    Generalizes :func:`paged_decode_attention` from per-row fixed small S to
+    per-row **variable** token counts: ``q`` is ``(1, T, N, H)`` token-major —
+    T packed tokens that may belong to different requests (1 per plain decode
+    row, K+1 per speculative verify window, a whole prompt chunk per
+    prefilling row) — and two scalar-prefetch maps say whose cache each token
+    reads: ``row_map`` ``(T,)`` picks the token's row of ``block_tables``
+    ``(R, W)`` and ``positions`` ``(T,)`` is its absolute position for the
+    ``j <= position`` visibility mask.
+
+    Grid is ``(T, W)``: grid row ``t`` walks exactly the pages
+    ``bt[row_map[t], :]`` with online-softmax state private to the token, so
+    cross-row leakage is impossible by construction — a token cannot even
+    address another request's pages, let alone attend them unmasked.  Pad
+    tokens point ``row_map`` at an all-null table row and sit at the null
+    position; their output is garbage-but-finite and never gathered.
+
+    The scheduler sizes T to a warmed token-budget bucket, so one compiled
+    shape per bucket serves every admission mix.  Returns ``(1, T, N, H)``
+    in ``q.dtype``; math is f32.  Off-TPU use ``interpret=True``.
+    """
+    B, T, N, H = q.shape
+    if B != 1:
+        raise ValueError(f"packed attention is token-major: expected B=1, got {B}")
+    num_pages, page_size, n_kv, _ = pool_k.shape
+    W = block_tables.shape[1]
+    if N % n_kv:
+        raise ValueError(f"num_heads={N} must divide by kv_heads={n_kv}")
+    if scale is None:
+        scale = H**-0.5
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if quantized:
+        ks = k_scale.astype(jnp.float32)
+        vs = v_scale.astype(jnp.float32)
+    else:
+        ks = jnp.ones((num_pages, n_kv), jnp.float32)
+        vs = ks
+
+    # token-major rows: (1, T, N, H) -> (T, N, H); within a token the N axis
+    # is head-major, so kv-head j's group block is the slice [j*g, (j+1)*g)
+    q3 = q.reshape(T, N, H)
+    bt = block_tables.astype(jnp.int32)
+    rm = row_map.reshape(T).astype(jnp.int32)
+    pos = positions.reshape(T).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _packed_paged_kernel,
+        sm_scale=float(scale),
+        page_size=page_size,
+        n_kv=n_kv,
+        quantized=quantized,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T, W),
+        in_specs=[
+            pl.BlockSpec((1, N, H), lambda t, w, rm, bt, pos: (t, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, n_kv, H),
+                lambda t, w, rm, bt, pos: (bt[rm[t], w], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, n_kv, H),
+                lambda t, w, rm, bt, pos: (bt[rm[t], w], 0, 0, 0),
+            ),
+            pl.BlockSpec((1, n_kv), lambda t, w, rm, bt, pos: (bt[rm[t], w], 0)),
+            pl.BlockSpec((1, n_kv), lambda t, w, rm, bt, pos: (bt[rm[t], w], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, N, H), lambda t, w, rm, bt, pos: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((N, 1), jnp.float32),
+            pltpu.VMEM((N, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, N, H), q.dtype),
+        interpret=interpret,
+    )(rm, bt, pos, q3, pool_k, pool_v, ks, vs)
+    return out.reshape(1, T, N, H)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from relora_tpu.ops.attention import (
     flash_block_size,
+    packed_paged_attention,
     paged_cached_attention,
     paged_decode_attention,
 )
@@ -68,9 +69,10 @@ __all__ = [
     "choose_arm",
     "choose_training_arm",
     "paged_attention",
+    "packed_attention",
 ]
 
-ARMS: Tuple[str, ...] = ("naive", "flash", "paged_decode")
+ARMS: Tuple[str, ...] = ("naive", "flash", "paged_decode", "packed")
 
 #: largest query length the fused paged kernel serves: covers plain decode
 #: (S=1) and every speculative verify window (K+1 for K <= 15) while the
@@ -138,7 +140,18 @@ def estimate_arm_times(
 
     paged_decode = roofline(qo_bytes + cache_bytes + scale_bytes, flops, 1)
 
-    return {"naive": naive, "flash": flash, "paged_decode": paged_decode}
+    # packed mixed-batch: per-token page streaming — identical HBM traffic
+    # shape to paged_decode at (B=T packed tokens, S=1), one launch for the
+    # whole mixed batch instead of one per entry kind (the win the dispatch
+    # count in serve metrics measures, not this table)
+    packed = roofline(qo_bytes + cache_bytes + scale_bytes, flops, 1)
+
+    return {
+        "naive": naive,
+        "flash": flash,
+        "paged_decode": paged_decode,
+        "packed": packed,
+    }
 
 
 @functools.lru_cache(maxsize=4096)
@@ -172,6 +185,10 @@ def choose_arm(
     candidates = [arm for arm in allow if arm in ARMS]
     if S > PAGED_DECODE_MAX_S or not fused_available:
         candidates = [a for a in candidates if a != "paged_decode"]
+    # the packed arm reads per-token row/position maps: callers rank it with
+    # (B = packed tokens, S = 1); any other query shape cannot address it
+    if S != 1 or not fused_available:
+        candidates = [a for a in candidates if a != "packed"]
     if S != S_kv or flash_block_size(S, S_kv) is None or not fused_available:
         candidates = [a for a in candidates if a != "flash"]
     if not candidates:
@@ -324,3 +341,63 @@ def paged_attention(
         q, pool_k, pool_v, block_tables, positions,
         k_scale=k_scale, v_scale=v_scale, scale=scale,
     )
+
+
+def packed_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    row_map: jax.Array,
+    positions: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    arm: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Attend a token-major packed mixed batch against the page pool.
+
+    ``q`` is ``(1, T, N, H)`` — T packed tokens from a mix of decode rows,
+    speculative verify windows, and prefill chunks — with ``row_map`` ``(T,)``
+    selecting each token's row of ``block_tables`` ``(R, W)`` and
+    ``positions`` ``(T,)`` its absolute position.  On TPU the fused
+    :func:`relora_tpu.ops.attention.packed_paged_attention` kernel serves it
+    in one launch; elsewhere (or with ``arm="naive"``) each token attends
+    through its own gathered table as a batch row of
+    :func:`relora_tpu.ops.attention.paged_cached_attention` — same masked
+    einsum math as the sequential decode path, which is what the
+    packed-vs-sequential token-parity tests lean on.
+    """
+    if arm not in ("auto", "naive", "packed"):
+        raise ValueError(f"unknown/unservable arm {arm!r}; expected auto|naive|packed")
+    B, T, N, H = q.shape
+    if B != 1:
+        raise ValueError(f"packed attention is token-major: expected B=1, got {B}")
+    _, page_size, n_kv, _ = pool_k.shape
+    S_kv = block_tables.shape[1] * page_size
+    rm = row_map.reshape(T)
+    pos = positions.reshape(T)
+    if arm == "auto":
+        fused_ok = jax.default_backend() == "tpu"
+        arm = choose_arm(
+            T, 1, S_kv, N, n_kv, H, page_size,
+            jnp.dtype(pool_k.dtype).itemsize,
+            fused_available=fused_ok, allow=("naive", "packed"),
+        )
+    if arm == "packed":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return packed_paged_attention(
+            q, pool_k, pool_v, block_tables, rm, pos,
+            k_scale=k_scale, v_scale=v_scale, scale=scale, interpret=interpret,
+        )
+    # naive: tokens become batch rows, each with its own table — (T, 1, N, H)
+    # queries against (T, W) per-token tables, then back to token-major
+    token_tables = jnp.take(block_tables, rm.astype(jnp.int32), axis=0)
+    out = paged_cached_attention(
+        q.reshape(T, 1, N, H), pool_k, pool_v, token_tables, pos.reshape(T, 1),
+        k_scale=k_scale, v_scale=v_scale, scale=scale,
+    )
+    return out.reshape(1, T, N, H)

@@ -216,9 +216,18 @@ class InferenceEngine:
         kv_dtype: str = "bf16",
         spec_k: int = 0,
         adapter_slots: int = 0,
+        token_budget: Optional[int] = None,
     ):
         if cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {cache_size}")
+        if token_budget is not None:
+            if page_size is None:
+                raise ValueError(
+                    "token_budget requires the paged engine (page_size set)"
+                )
+            if token_budget < 1:
+                raise ValueError(f"token_budget must be >= 1, got {token_budget}")
+        self.token_budget = token_budget or 0
         if adapter_slots:
             if lora is None:
                 raise ValueError(
@@ -410,6 +419,28 @@ class InferenceEngine:
             # it gets its own watcher entry and jit cache
             self._verify_paged = cw.wrap(
                 "verify_paged", jax.jit(prefill_chunk_fn, donate_argnums=(3,))
+            )
+
+            def step_paged_fn(p, ids, positions, pool, block_tables, row_map, adapter_idx):
+                # the packed mixed-batch forward: one (1, Tb) token-major
+                # window where row_map[t] names the slot token t belongs to.
+                # Attention routes each token through its own block table
+                # (models/llama.attend_with_paged_cache row_map path), so a
+                # single dispatch serves every decode row, verify window, and
+                # however many prefill chunks the token budget admitted.
+                logits, variables = self.paged_model.apply(
+                    {"params": p, "cache": pool},
+                    ids,
+                    positions=positions,
+                    block_tables=block_tables,
+                    adapter_idx=adapter_idx,
+                    row_map=row_map,
+                    mutable=["cache"],
+                )
+                return logits, variables["cache"]
+
+            self._step_paged = cw.wrap(
+                "step_paged", jax.jit(step_paged_fn, donate_argnums=(3,))
             )
 
     # -- cache construction --------------------------------------------------
@@ -750,6 +781,57 @@ class InferenceEngine:
             self._row_idx(adapter_idx, tokens.shape[0]),
         )
 
+    def step_paged(
+        self,
+        pool: PyTree,
+        ids: jax.Array,
+        positions: jax.Array,
+        block_tables,
+        row_map,
+        adapter_idx=None,
+    ) -> Tuple[jax.Array, PyTree]:
+        """One packed mixed-batch step: ``ids``/``positions`` are ``(1, Tb)``
+        token-major, ``row_map`` is ``(Tb,)`` mapping each packed token to
+        the block-table row it belongs to, ``block_tables`` is
+        ``(rows, W+1)`` — every slot's table plus a trailing null column and
+        a final all-null pad row.  Pad tokens carry ``row_map = rows-1`` and
+        ``positions = cache_size`` so their writes clip into the null page.
+        ``adapter_idx`` is per-TOKEN here (``(Tb,)``), not per-row — the
+        grouped LoRA kernel sees one row per packed token.  Returns full
+        window logits ``(1, Tb, V)`` and the updated pool (input donated).
+        Token t's K/V is written before any token attends, so later packed
+        tokens of the same request attend earlier same-dispatch tokens —
+        whole prompts can prefill inside one step."""
+        self._require_paged()
+        T = ids.shape[1]
+        return self._step_paged(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(positions, jnp.int32),
+            pool,
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(row_map, jnp.int32),
+            self._row_idx(adapter_idx, T),
+        )
+
+    def packed_buckets(self) -> Tuple[int, ...]:
+        """The packed-step shapes warmed and used at steady state: halving
+        from ``token_budget`` down to 8, so a lightly loaded round (a few
+        decode rows, no prefill backlog) pads to a small bucket instead of
+        the full budget.  A handful of shapes replaces the per-bucket
+        chunk/decode/verify warmup trio."""
+        self._require_paged()
+        if not self.token_budget:
+            raise ValueError("engine was built without token_budget: no packed step")
+        buckets = set()
+        t = self.token_budget
+        while True:
+            buckets.add(t)
+            if t <= 8:
+                break
+            t = max(8, t // 2)
+        return tuple(sorted(buckets))
+
     def default_prompt_buckets(self) -> Tuple[int, ...]:
         """Every prefill shape a prompt can actually land in: powers of two
         from the bucket minimum up, capped at ``cache_size`` (which is
@@ -763,7 +845,13 @@ class InferenceEngine:
         buckets.append(self.cache_size)
         return tuple(buckets)
 
-    def warmup(self, batch: int, *, prompt_buckets: Optional[Sequence[int]] = None) -> dict:
+    def warmup(
+        self,
+        batch: int,
+        *,
+        prompt_buckets: Optional[Sequence[int]] = None,
+        packed: bool = False,
+    ) -> dict:
         """Compile the serving step functions before traffic arrives.
         An online server calls this at startup so the first real request
         pays queueing latency, not XLA compilation.
@@ -775,12 +863,55 @@ class InferenceEngine:
         ``batch`` rows.  Paged engine: exactly two shapes total, the
         ``(1, chunk_size)`` prefill chunk and the ``(batch, 1)`` paged
         decode — prompt length no longer appears in any compiled shape.
+        Packed paged engine (``packed=True``, requires ``token_budget``):
+        one ``step_paged`` compile per token-budget bucket
+        (``packed_buckets()``) replaces the chunk/decode/verify trio —
+        the scheduler's round then never issues any other model entry, so
+        admission/cancel/spec churn cannot retrace.
 
         Returns a report of what was compiled — shapes plus per-compile
         durations — so operators can log it and compile telemetry can tell
         these expected compiles apart from steady-state retraces."""
         cw = self.compile_watcher
         n_before = len(cw.compile_events())
+        if packed:
+            self._require_paged()
+            buckets = self.packed_buckets()
+            W1 = self.block_table_width + 1
+            with cw.expected_compiles("warmup"):
+                pool = self.init_pool()
+                logits = None
+                for Tb in buckets:
+                    logits, pool = self.step_paged(
+                        pool,
+                        jnp.zeros((1, Tb), jnp.int32),
+                        jnp.full((1, Tb), self.cache_size, jnp.int32),
+                        jnp.zeros((batch + 1, W1), jnp.int32),
+                        jnp.full((Tb,), batch, jnp.int32),
+                    )
+                if self.adapter_slots:
+                    self.write_adapter_slot(
+                        self.adapter_slots - 1, self._factor_template, 0.0
+                    )
+                jax.block_until_ready(logits)
+            events = cw.compile_events()[n_before:]
+            shapes: dict = {"step_paged": [[1, Tb] for Tb in buckets]}
+            if self.adapter_slots:
+                shapes["adapter_write"] = [self.adapter_slots]
+            return {
+                "batch": batch,
+                "prompt_buckets": [],
+                "packed_buckets": list(buckets),
+                "token_budget": self.token_budget,
+                "kv_dtype": self.kv_dtype,
+                "spec_k": self.spec_k,
+                "shapes": shapes,
+                "n_compiles": len(events),
+                "compiles": [
+                    {"fn": ev.fn, "duration_s": round(ev.duration_s, 4), "reason": ev.reason}
+                    for ev in events
+                ],
+            }
         if self.paged:
             with cw.expected_compiles("warmup"):
                 pool = self.init_pool()
@@ -921,6 +1052,18 @@ class InferenceEngine:
                     pool,
                     jax.ShapeDtypeStruct((batch, self.block_table_width + 1), i32),
                     jax.ShapeDtypeStruct((batch,), i32),
+                )
+            if self.token_budget:
+                Tb = self.token_budget
+                plans["step_paged"] = obs_memory.plan_for(
+                    self._step_paged,
+                    self.params,
+                    jax.ShapeDtypeStruct((1, Tb), i32),
+                    jax.ShapeDtypeStruct((1, Tb), i32),
+                    pool,
+                    jax.ShapeDtypeStruct((batch + 1, self.block_table_width + 1), i32),
+                    jax.ShapeDtypeStruct((Tb,), i32),
+                    jax.ShapeDtypeStruct((Tb,), i32),
                 )
             return plans
         if prompt_buckets is None:

@@ -180,6 +180,14 @@ def spec_verify_draws(
         keys
     )
     alt_keys = jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, _SPEC_ALT)))(keys)
+    # a row that drafted nothing commits exactly one token — the bonus draw
+    # at window slot 0 — and consumes no acceptance uniform, so it uses the
+    # PLAIN (uid, token_index) key there: its committed stream is bit-equal
+    # to the non-window sample() path no matter which rounds carried drafts
+    # for other rows (the packed scheduler relies on this invariance)
+    no_draft = (k_eff.astype(jnp.int32) == 0)[:, None]  # (B, 1)
+    slot0 = jnp.arange(S, dtype=jnp.int32)[None, :] == 0
+    alt_keys = jnp.where((no_draft & slot0)[..., None], keys, alt_keys)
 
     # acceptance: rows 0..S-2 judge draft[:, 0..S-2]
     p_draft = jnp.take_along_axis(probs[:, :-1, :], draft[..., None], axis=-1)[..., 0]

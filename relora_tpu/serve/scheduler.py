@@ -699,6 +699,16 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
     tests/test_paging.py pins under cancel/expiry mid-stream.  A round
     where no row drafted falls back to the plain ``decode_paged`` shape, so
     both steady-state shapes are warmed and nothing retraces.
+
+    ``packed=True`` (engine built with ``token_budget``) replaces the whole
+    round with ONE ``step_paged`` dispatch: every decoding row's window plus
+    oldest-first prefill tokens from *multiple* slots, token-budget
+    (Sarathi) style, padded to the smallest warmed bucket.  Prefill no
+    longer serializes one chunk per round, decode rides a compute-dense
+    forward instead of a memory-bound ``(B, 1)`` step, and per-round
+    dispatch overhead halves — while sampling reuses the sequential calls
+    and keys verbatim, so the drain stays token-identical (pinned by
+    tests/test_packed.py).
     """
 
     #: longest context suffix the prompt-lookup drafter tries to match
@@ -711,6 +721,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         prefix_cache: bool = True,
         prefix_cache_entries: int = 256,
         spec: str = "off",
+        packed: bool = False,
         **kwargs,
     ):
         super().__init__(engine, **kwargs)
@@ -730,6 +741,24 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 "PagedContinuousBatchingScheduler needs an engine built with "
                 "page_size/num_pages (got a contiguous InferenceEngine)"
             )
+        self._packed = packed
+        if packed:
+            if not getattr(engine, "token_budget", 0):
+                raise ValueError(
+                    "packed=True needs an engine built with token_budget "
+                    "(the packed step compiles at the budget's buckets)"
+                )
+            # every decoding row must fit its whole round window in one
+            # dispatch — the budget only throttles prefill, never decode
+            floor = self.max_batch * (
+                engine.spec_k + 1 if spec == "ngram" else 1
+            )
+            if engine.token_budget < floor:
+                raise ValueError(
+                    f"token_budget ({engine.token_budget}) cannot hold every "
+                    f"decode row's window: need >= {floor} "
+                    f"(max_batch x window size)"
+                )
         self.allocator = PageAllocator(
             engine.num_pages,
             engine.page_size,
@@ -744,9 +773,26 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         # per-row decode block tables: NULL rows for free / still-prefilling
         # slots, so their garbage decode write lands in the null page
         self._tables = np.zeros((self.max_batch, engine.block_table_width), np.int32)
+        # the packed step's table matrix: every slot's table (W plus the
+        # trailing null column) and a final all-null pad row that padding
+        # tokens' row_map points at — maintained from admission so packed
+        # rounds never rebuild tables on the hot path
+        self._ptables = np.zeros(
+            (self.max_batch + 1, engine.block_table_width + 1), np.int32
+        )
         self._admit_seq = 0  # admission order, drives chunk scheduling (FIFO)
         self._pad_tokens = 0  # chunk padding written, cumulative
         self._prefill_tokens = 0  # real prompt tokens written, cumulative
+        # dispatch economics (cumulative): rounds, model dispatches, and
+        # packed-window tokens (total vs real) — the gauges the packed step
+        # exists to move (serve/dispatches_per_round, tokens_per_dispatch,
+        # packed_token_utilization)
+        self._round_total = 0
+        self._dispatch_total = 0
+        self._dispatch_tokens = 0
+        self._dispatch_tokens_real = 0
+        self._admit_time_s = 0.0  # cumulative prefill/admission wall time
+        self._decode_time_s = 0.0  # cumulative decode/packed-step wall time
         # static for the engine's lifetime (pool shapes never change): the
         # serve/kv_cache_bytes and serve/kv_bytes_per_token gauges
         self._kv_cache_bytes = engine.pool_bytes()
@@ -830,6 +876,11 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             self._tokens[slot_idx] = 0
             self._positions[slot_idx] = 0
             self._tables[slot_idx, :] = 0
+            # the packed table row is live from admission: prefill tokens
+            # route through it the same round they are admitted
+            self._ptables[slot_idx, :] = 0
+            pages = shared_pages + fresh
+            self._ptables[slot_idx, : len(pages)] = pages
             self._adapter_row[slot_idx] = adapter_slot
 
     # -- prefill (one chunk per round) ----------------------------------------
@@ -868,6 +919,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 jnp.asarray(ids), start, self._ensure_pool(), table,
                 adapter_idx=[slot.adapter_slot],
             )
+            self._count_dispatch(chunk, n_real)
             slot.prefill_progress = start + n_real
             if slot.prefill_progress >= L:
                 first = self.engine._sample(
@@ -972,10 +1024,12 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             starts[slot_idx] = len(slot.tokens)
             temps[slot_idx] = slot.request.temperature
             top_ps[slot_idx] = slot.request.top_p
+        n_dec = sum(1 for s in self._slots if s is not None and s.decoding)
         logits, self._pool = self.engine.verify_paged(
             self._ensure_pool(), tokens, positions, tables,
             adapter_idx=self._adapter_row,
         )
+        self._count_dispatch(B * S, n_dec + int(k_eff.sum()))
         accept, alt = self._spec_sample(
             logits,
             jnp.asarray(draft_mat),
@@ -987,10 +1041,30 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             top_k=self.top_k,
             top_p=jnp.asarray(top_ps),
         )
-        accept = np.asarray(accept)
-        alt = np.asarray(alt)
+        self._commit_spec_walk(
+            np.asarray(accept), np.asarray(alt), draft_mat, k_eff,
+            set(i for i, s in enumerate(self._slots) if s is not None and s.decoding),
+            finished,
+        )
+
+    def _commit_spec_walk(
+        self,
+        accept: np.ndarray,
+        alt: np.ndarray,
+        draft_mat: np.ndarray,
+        k_eff: np.ndarray,
+        eligible: set,
+        finished: List[Completion],
+    ) -> None:
+        """The host-side accept walk shared by the sequential verify round
+        and the packed step: for each eligible row commit the longest
+        accepted draft prefix plus one corrective token through the normal
+        emit/finish flow, stopping at EOS.  ``eligible`` is the set of slot
+        indices that actually rode the verify window (the packed step must
+        exclude slots it armed for decode *after* the dispatch)."""
         drafted = accepted = 0
-        for slot_idx, slot in enumerate(self._slots):
+        for slot_idx in sorted(eligible):
+            slot = self._slots[slot_idx]
             if slot is None or not slot.decoding:
                 continue
             k = int(k_eff[slot_idx])
@@ -1022,9 +1096,14 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
     def step(self) -> List[Completion]:
         """One budgeted round: expire deadlines, admit (page accounting
         only), at most one prefill chunk, then one paged decode over every
-        decoding slot.  Returns the requests that finished during it."""
+        decoding slot.  Returns the requests that finished during it.
+        ``packed=True`` replaces the whole round body with the single-
+        dispatch packed step (``_step_packed``)."""
+        if self._packed:
+            return self._step_packed()
         finished: List[Completion] = []
         t_step = time.monotonic()
+        d0 = self._dispatch_total
         self._expire_deadlines(finished)
         self._admit_pass(finished)
         self._prefill_pass(finished)
@@ -1034,6 +1113,9 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         ]
         n_decoding = sum(decoding)
         if n_decoding == 0:
+            if self._dispatch_total > d0:
+                self._count_round()  # pure-prefill round still dispatched
+                self._admit_time_s += admit_s  # a 100%-stall round
             return finished  # pure-prefill round (or idle)
 
         t_decode = time.monotonic()
@@ -1061,6 +1143,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                     self._tables,
                     adapter_idx=self._adapter_row,
                 )
+                self._count_dispatch(self.max_batch, n_decoding)
                 self._step_count += 1
                 masked = [
                     s if (s is not None and s.decoding) else None for s in self._slots
@@ -1068,29 +1151,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 next_tokens = self._sample_rows(logits, masked).tolist()
         decode_s = time.monotonic() - t_decode
         self._observe("decode_step_seconds", decode_s)
-        batch_fill = n_decoding / self.max_batch
-        stall_share = admit_s / max(admit_s + decode_s, 1e-9)
-        pad_share = self._pad_tokens / max(self._pad_tokens + self._prefill_tokens, 1)
-        hit_rate = self.prefix_cache.hit_rate if self.prefix_cache is not None else 0.0
-        if self.obs_registry is not None:
-            self.obs_registry.set_gauge("batch_fill", batch_fill)
-            self.obs_registry.set_gauge("prefill_stall_share", stall_share)
-            self.obs_registry.set_gauge("kv_pages_used", self.allocator.used_pages)
-            self.obs_registry.set_gauge("kv_pages_free", self.allocator.free_pages)
-            self.obs_registry.set_gauge("prefix_cache_hit_rate", hit_rate)
-            self.obs_registry.set_gauge("prefill_pad_share", pad_share)
-            self.obs_registry.set_gauge("kv_cache_bytes", self._kv_cache_bytes)
-            self.obs_registry.set_gauge("kv_bytes_per_token", self._kv_bytes_per_token)
-            if self._spec != "off":
-                self.obs_registry.set_gauge(
-                    "spec_accept_rate",
-                    self._spec_accepted / max(self._spec_drafted, 1),
-                )
-                # by=0 materializes the counters at 0 so a spec server's
-                # /metrics always exposes them, drafts or not (and scrapers'
-                # delta logic sees the series from the start)
-                self.obs_registry.inc("spec_drafted_total", by=0)
-                self.obs_registry.inc("spec_accepted_total", by=0)
+        self._count_round()
         if next_tokens is not None:
             for slot_idx, slot in enumerate(self._slots):
                 if slot is None or not slot.decoding:
@@ -1102,6 +1163,65 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 self._positions[slot_idx] = slot.pos
                 self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
                 self._finish_if_done(slot_idx, finished)
+        self._round_metrics(admit_s, decode_s, n_decoding)
+        return finished
+
+    # -- dispatch accounting ----------------------------------------------------
+
+    def _count_dispatch(self, tokens: int, real: int) -> None:
+        """One model dispatch of ``tokens`` window positions, ``real`` of
+        which carried live work (the rest is shape padding)."""
+        self._dispatch_total += 1
+        self._dispatch_tokens += tokens
+        self._dispatch_tokens_real += real
+        if self.obs_registry is not None:
+            self.obs_registry.inc("model_dispatches_total")
+            self.obs_registry.inc("dispatch_tokens_total", by=tokens)
+            self.obs_registry.inc("dispatch_tokens_real_total", by=real)
+
+    def _count_round(self) -> None:
+        self._round_total += 1
+        if self.obs_registry is not None:
+            self.obs_registry.inc("sched_rounds_total")
+
+    def _round_metrics(self, admit_s: float, decode_s: float, n_decoding: int) -> None:
+        """Publish the round's gauges and metrics.jsonl record — shared by
+        the sequential and packed step bodies so both expose an identical
+        telemetry surface."""
+        batch_fill = n_decoding / self.max_batch
+        stall_share = admit_s / max(admit_s + decode_s, 1e-9)
+        self._admit_time_s += admit_s
+        self._decode_time_s += decode_s
+        pad_share = self._pad_tokens / max(self._pad_tokens + self._prefill_tokens, 1)
+        hit_rate = self.prefix_cache.hit_rate if self.prefix_cache is not None else 0.0
+        dispatches_per_round = self._dispatch_total / max(self._round_total, 1)
+        tokens_per_dispatch = self._dispatch_tokens / max(self._dispatch_total, 1)
+        token_utilization = self._dispatch_tokens_real / max(self._dispatch_tokens, 1)
+        if self.obs_registry is not None:
+            self.obs_registry.set_gauge("batch_fill", batch_fill)
+            self.obs_registry.set_gauge("prefill_stall_share", stall_share)
+            self.obs_registry.set_gauge("kv_pages_used", self.allocator.used_pages)
+            self.obs_registry.set_gauge("kv_pages_free", self.allocator.free_pages)
+            self.obs_registry.set_gauge("prefix_cache_hit_rate", hit_rate)
+            self.obs_registry.set_gauge("prefill_pad_share", pad_share)
+            self.obs_registry.set_gauge("kv_cache_bytes", self._kv_cache_bytes)
+            self.obs_registry.set_gauge("kv_bytes_per_token", self._kv_bytes_per_token)
+            self.obs_registry.set_gauge("dispatches_per_round", dispatches_per_round)
+            self.obs_registry.set_gauge("tokens_per_dispatch", tokens_per_dispatch)
+            self.obs_registry.set_gauge("packed_token_utilization", token_utilization)
+            # by=0 materializes the counters at 0 so /metrics always exposes
+            # them (and scrapers' delta logic sees the series from the start)
+            self.obs_registry.inc("model_dispatches_total", by=0)
+            self.obs_registry.inc("sched_rounds_total", by=0)
+            self.obs_registry.inc("dispatch_tokens_total", by=0)
+            self.obs_registry.inc("dispatch_tokens_real_total", by=0)
+            if self._spec != "off":
+                self.obs_registry.set_gauge(
+                    "spec_accept_rate",
+                    self._spec_accepted / max(self._spec_drafted, 1),
+                )
+                self.obs_registry.inc("spec_drafted_total", by=0)
+                self.obs_registry.inc("spec_accepted_total", by=0)
         record = None
         if self.metrics is not None:
             watcher = getattr(self.engine, "compile_watcher", None)
@@ -1118,6 +1238,9 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 "serve/prefill_pad_share": round(pad_share, 4),
                 "serve/kv_cache_bytes": self._kv_cache_bytes,
                 "serve/kv_bytes_per_token": round(self._kv_bytes_per_token, 4),
+                "serve/dispatches_per_round": round(dispatches_per_round, 4),
+                "serve/tokens_per_dispatch": round(tokens_per_dispatch, 4),
+                "serve/packed_token_utilization": round(token_utilization, 4),
                 "compile/steady_state_retraces": (
                     watcher.steady_state_retraces if watcher is not None else 0
                 ),
@@ -1131,6 +1254,217 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         self._adapter_gauges(record)
         if record is not None:
             self.metrics.log(record)
+
+    # -- the packed single-dispatch round ---------------------------------------
+
+    def _step_packed(self) -> List[Completion]:
+        """Sarathi-style token-budget round in ONE model dispatch: every
+        decoding row's window first (1 token plain, ``spec_k+1`` when any
+        row drafted — mirroring the sequential round's branch structure),
+        then oldest-first prefill tokens from as many slots as the budget
+        admits, padded up to the smallest warmed bucket.  Each packed token
+        routes through its own slot's block table (``row_map``), so the
+        forward is exactly the sequential dispatches fused.  Sampling reuses
+        the sequential path's calls verbatim — same ``(uid, token_index)``
+        keys, same scalar-vs-stacked key structure — so the drain is
+        token-identical to the unpacked scheduler."""
+        finished: List[Completion] = []
+        t_step = time.monotonic()
+        self._expire_deadlines(finished)
+        self._admit_pass(finished)
+        admit_s = time.monotonic() - t_step
+        if not any(s is not None for s in self._slots):
+            return finished
+
+        t_decode = time.monotonic()
+        engine = self.engine
+        B = self.max_batch
+        null_pos = engine.cache_size
+        spec_k = engine.spec_k
+
+        drafts = self._draft_pass() if self._spec == "ngram" else {}
+        spec_mode = bool(drafts)
+        S = spec_k + 1 if spec_mode else 1
+
+        ids: List[int] = []
+        poss: List[int] = []
+        rows: List[int] = []
+        adap: List[int] = []
+        slot_off: Dict[int, int] = {}  # decoding slot -> its window's offset
+
+        # decode/verify windows first — the budget never throttles decode
+        # (ctor floor check); k_eff=0 rows ride the full window in spec mode,
+        # mirroring _verify_round
+        draft_mat = np.zeros((B, max(spec_k, 1)), np.int32)
+        k_eff = np.zeros(B, np.int32)
+        uids = np.zeros(B, np.int32)
+        starts = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ps = np.ones(B, np.float32)
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding:
+                continue
+            slot_off[slot_idx] = len(ids)
+            d = drafts.get(slot_idx, [])
+            window = [int(self._tokens[slot_idx])] + [int(t) for t in d]
+            window += [0] * (S - len(window))
+            ids.extend(window)
+            poss.extend(int(self._positions[slot_idx]) + j for j in range(S))
+            rows.extend([slot_idx] * S)
+            adap.extend([slot.adapter_slot] * S)
+            draft_mat[slot_idx, : len(d)] = d
+            k_eff[slot_idx] = len(d)
+            uids[slot_idx] = slot.request.uid
+            starts[slot_idx] = len(slot.tokens)
+            temps[slot_idx] = slot.request.temperature
+            top_ps[slot_idx] = slot.request.top_p
+        n_decoding = len(slot_off)
+
+        # oldest-first prefill from MULTIPLE slots into the leftover budget;
+        # write-then-attend makes several chunks of one prompt inside one
+        # dispatch correct, so a slot may clear its whole backlog here
+        budget_left = engine.token_budget - len(ids)
+        prefill_spans: List[tuple] = []  # (slot_idx, start, n, packed offset)
+        for _, slot_idx in sorted(
+            (s.seq, i)
+            for i, s in enumerate(self._slots)
+            if s is not None and not s.decoding
+        ):
+            if budget_left <= 0:
+                break
+            slot = self._slots[slot_idx]
+            req = slot.request
+            start = slot.prefill_progress
+            n = min(len(req.prompt) - start, budget_left)
+            if n <= 0:
+                continue
+            prefill_spans.append((slot_idx, start, n, len(ids)))
+            ids.extend(int(t) for t in req.prompt[start : start + n])
+            poss.extend(range(start, start + n))
+            rows.extend([slot_idx] * n)
+            adap.extend([slot.adapter_slot] * n)
+            budget_left -= n
+
+        n_real = len(ids)
+        if n_real == 0:
+            return finished  # nothing decodable and nothing left to prefill
+        bucket = next(b for b in engine.packed_buckets() if b >= n_real)
+        pad = bucket - n_real
+        ids.extend([0] * pad)
+        poss.extend([null_pos] * pad)  # clips into the null page
+        rows.extend([B] * pad)  # the all-null pad row of _ptables
+        adap.extend([0] * pad)
+        self._pad_tokens += pad
+        self._prefill_tokens += sum(n for _, _, n, _ in prefill_spans)
+
+        with self.tracer.span(
+            "decode_step",
+            step=self._step_count,
+            active_slots=n_decoding,
+            spec_drafted=int(k_eff.sum()),
+            packed_tokens=bucket,
+        ):
+            logits, self._pool = engine.step_paged(
+                self._ensure_pool(),
+                np.asarray(ids, np.int32)[None, :],
+                np.asarray(poss, np.int32)[None, :],
+                self._ptables,
+                np.asarray(rows, np.int32),
+                adapter_idx=np.asarray(adap, np.int32),
+            )
+            self._step_count += 1
+
+            # decode rows first (before any slot armed this round joins the
+            # decoding set): gather each window's logits from its packed
+            # offsets and reuse the sequential sampling calls unchanged
+            if n_decoding:
+                flat = logits[0]
+                if spec_mode:
+                    win_idx = np.zeros(B * S, np.int32)
+                    for slot_idx, off in slot_off.items():
+                        win_idx[slot_idx * S : (slot_idx + 1) * S] = off + np.arange(S)
+                    win = jnp.take(flat, jnp.asarray(win_idx), axis=0).reshape(
+                        B, S, flat.shape[-1]
+                    )
+                    accept, alt = self._spec_sample(
+                        win,
+                        jnp.asarray(draft_mat),
+                        self.key,
+                        jnp.asarray(uids),
+                        jnp.asarray(starts),
+                        jnp.asarray(k_eff),
+                        temperature=jnp.asarray(temps),
+                        top_k=self.top_k,
+                        top_p=jnp.asarray(top_ps),
+                    )
+                    self._commit_spec_walk(
+                        np.asarray(accept), np.asarray(alt), draft_mat, k_eff,
+                        set(slot_off), finished,
+                    )
+                else:
+                    sample_idx = np.zeros(B, np.int32)
+                    for slot_idx, off in slot_off.items():
+                        sample_idx[slot_idx] = off
+                    gathered = jnp.take(flat, jnp.asarray(sample_idx), axis=0)
+                    masked = [
+                        s if i in slot_off else None
+                        for i, s in enumerate(self._slots)
+                    ]
+                    next_tokens = self._sample_rows(gathered, masked).tolist()
+                    for slot_idx in sorted(slot_off):
+                        slot = self._slots[slot_idx]
+                        if slot is None:
+                            continue  # retired mid-walk (cannot happen here)
+                        tok = next_tokens[slot_idx]
+                        slot.tokens.append(tok)
+                        slot.pos += 1
+                        self._tokens[slot_idx] = tok
+                        self._positions[slot_idx] = slot.pos
+                        self._emit_token(slot.request.uid, tok, len(slot.tokens) - 1)
+                        self._finish_if_done(slot_idx, finished)
+
+            # prefill completions: the same per-slot scalar sample call and
+            # (uid, 0) key as the sequential chunk path, so first tokens
+            # match exactly; the slot joins the decode set next round
+            for slot_idx, start, n, off in prefill_spans:
+                slot = self._slots[slot_idx]
+                if slot is None:
+                    continue
+                req = slot.request
+                slot.prefill_progress = start + n
+                L = len(req.prompt)
+                if slot.prefill_progress < L:
+                    continue
+                first = engine._sample(
+                    logits[:, off + n - 1, :],
+                    self._request_key(req, 0),
+                    temperature=req.temperature,
+                    top_k=self.top_k,
+                    top_p=req.top_p,
+                )
+                first_id = int(np.asarray(first)[0])
+                if self.prefix_cache is not None:
+                    self.prefix_cache.register(list(req.prompt), slot.pages)
+                slot.decoding = True
+                slot.tokens = [first_id]
+                slot.pos = L
+                slot.t_first = time.monotonic()
+                slot.span = self.tracer.start_span(
+                    "decode", trace_id=self._trace_ids.get(req.uid), uid=req.uid
+                )
+                self._tokens[slot_idx] = first_id
+                self._positions[slot_idx] = L
+                self._tables[slot_idx, : len(slot.pages)] = slot.pages
+                self._emit_token(req.uid, first_id, 0)
+                self._finish_if_done(slot_idx, finished)
+        decode_s = time.monotonic() - t_decode
+        self._observe("decode_step_seconds", decode_s)
+        # dispatch and round tick together at round end: a concurrent
+        # /healthz read between the engine call and here must never see the
+        # packed invariant (dispatches == rounds) transiently violated
+        self._count_dispatch(bucket, n_real)
+        self._count_round()
+        self._round_metrics(admit_s, decode_s, n_decoding)
         return finished
 
     # -- retirement (page bookkeeping) ----------------------------------------
@@ -1147,6 +1481,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             self.allocator.decref(slot.pages)
             slot.pages = []
         self._tables[slot_idx, :] = 0
+        self._ptables[slot_idx, :] = 0
         self._tokens[slot_idx] = 0
         self._positions[slot_idx] = 0
         return completion
@@ -1169,6 +1504,38 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             stats["prefix_cache"] = self.prefix_cache.stats()
         if self._spec != "off":
             stats["spec"] = self.spec_stats()
+        stats["dispatch"] = self.dispatch_stats()
+        return stats
+
+    def dispatch_stats(self) -> Dict[str, Any]:
+        """Cumulative dispatch-economics counters — the /healthz
+        ``dispatch`` block bench.py reads per-level deltas from."""
+        stats: Dict[str, Any] = {
+            "mode": "packed" if self._packed else "sequential",
+            "rounds": self._round_total,
+            "model_dispatches": self._dispatch_total,
+            "dispatches_per_round": round(
+                self._dispatch_total / max(self._round_total, 1), 4
+            ),
+            "tokens_total": self._dispatch_tokens,
+            "tokens_real": self._dispatch_tokens_real,
+            "tokens_per_dispatch": round(
+                self._dispatch_tokens / max(self._dispatch_total, 1), 4
+            ),
+            "packed_token_utilization": round(
+                self._dispatch_tokens_real / max(self._dispatch_tokens, 1), 4
+            ),
+            "admit_time_s": round(self._admit_time_s, 6),
+            "decode_time_s": round(self._decode_time_s, 6),
+            "prefill_stall_share": round(
+                self._admit_time_s
+                / max(self._admit_time_s + self._decode_time_s, 1e-9),
+                4,
+            ),
+        }
+        if self._packed:
+            stats["token_budget"] = self.engine.token_budget
+            stats["buckets"] = list(self.engine.packed_buckets())
         return stats
 
     def spec_stats(self) -> Dict[str, Any]:

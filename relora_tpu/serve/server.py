@@ -18,7 +18,10 @@ Endpoints:
   ``"draining"`` (SIGTERM), ``"stuck"`` (stall watchdog: no decode step for
   ``stall_timeout_s``), or ``"error"`` (model thread died) — the router
   (serve/router.py) ejects a replica on any 503 and re-adopts it when the
-  status clears.
+  status clears.  Paged schedulers attach a ``paging`` block (pool
+  pressure, prefix-cache stats, and — under ``paging.dispatch`` — the
+  dispatch-economics counters: dispatches per round, tokens per dispatch,
+  and packed-token utilization when ``--packed`` is on).
 - ``GET /metrics`` — Prometheus text exposition (serve/admission.ServeMetrics).
 
 Flow control, end to end:

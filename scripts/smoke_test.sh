@@ -372,6 +372,63 @@ wait "$ADPT_PID"
 grep -q "serve/adapter_slots_used" "$WORK/adapter_run/metrics.jsonl"
 grep -q "serve/adapter_hit_rate" "$WORK/adapter_run/metrics.jsonl"
 
+echo "=== 9f. packed paged server (--packed, one dispatch per round, token parity vs 9b) ==="
+rm -f "$WORK/packed_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/packed_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 6 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --packed \
+    --run-dir "$WORK/packed_run" &
+PACKED_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/packed_port" ] && break; sleep 0.2; done
+[ -s "$WORK/packed_port" ] || { echo "packed server never wrote its port"; kill "$PACKED_PID"; exit 1; }
+python - "$(cat "$WORK/packed_port")" "$WORK/paged_tokens.json" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+assert health["status"] == "ok", health
+dispatch = health["paging"]["dispatch"]
+assert dispatch["mode"] == "packed", dispatch
+assert dispatch["token_budget"] > 0 and dispatch["buckets"], dispatch
+
+def generate(prompt):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 6}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+    final = json.loads(events[-2])
+    assert final["finish_reason"] == "length" and len(final["tokens"]) == 6, final
+    return final["tokens"]
+
+# the 9b prompt set again: the packed single-dispatch round must produce
+# exactly the tokens the sequential paged server produced
+want = json.load(open(sys.argv[2]))
+long_prompt = [(i % 100) + 1 for i in range(40)]
+got = generate(long_prompt)
+assert got == want, f"packed step diverged from sequential: {got} != {want}"
+generate([1, 2, 3])
+assert generate(long_prompt) == want, "packed prefix-cache replay diverged"
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+dispatch = health["paging"]["dispatch"]
+# the tentpole invariant: every round that dispatched, dispatched once
+assert dispatch["rounds"] > 0, dispatch
+assert dispatch["dispatches_per_round"] == 1.0, dispatch
+assert 0.0 < dispatch["packed_token_utilization"] <= 1.0, dispatch
+metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+assert "relora_serve_dispatches_per_round" in metrics, metrics
+assert "relora_serve_tokens_per_dispatch" in metrics, metrics
+assert "relora_serve_packed_token_utilization" in metrics, metrics
+assert "relora_serve_model_dispatches_total" in metrics, metrics
+print("packed paged HTTP OK:", got, "| dispatch:", dispatch)
+EOF
+kill -TERM "$PACKED_PID"
+wait "$PACKED_PID"
+grep -q "serve/dispatches_per_round" "$WORK/packed_run/metrics.jsonl"
+grep -q "serve/tokens_per_dispatch" "$WORK/packed_run/metrics.jsonl"
+grep -q "serve/packed_token_utilization" "$WORK/packed_run/metrics.jsonl"
+
 echo "=== 10. traced run + SIGTERM flight dump (obs subsystem) ==="
 # fault injection fires a real SIGTERM at update 4; the PreemptionGuard
 # handler dumps the span flight recorder before the emergency checkpoint

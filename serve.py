@@ -98,6 +98,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--chunk-size", type=int, default=64, help="paged: prefill chunk length")
     p.add_argument(
+        "--packed",
+        action="store_true",
+        help="paged: packed mixed-batch rounds — ONE step_paged dispatch per "
+        "round carrying every decode/verify window plus token-budget prefill "
+        "from multiple slots (Sarathi-style; token-identical output, "
+        "docs/serving.md)",
+    )
+    p.add_argument(
+        "--token-budget",
+        type=int,
+        default=0,
+        help="packed: max tokens per packed dispatch (0 = max_batch x "
+        "(spec_k+1) + chunk_size); larger buckets raise throughput per "
+        "dispatch, smaller bound per-round TTFT/TPOT jitter",
+    )
+    p.add_argument(
         "--tp",
         type=int,
         default=1,
@@ -303,6 +319,16 @@ def main(argv=None) -> int:
             kv_dtype=args.kv_dtype,
             spec_k=args.spec_k if args.spec != "off" else 0,
         )
+        if args.packed:
+            window = (args.spec_k + 1) if args.spec != "off" else 1
+            paged_kwargs["token_budget"] = args.token_budget or (
+                args.max_batch * window + args.chunk_size
+            )
+    elif args.packed:
+        raise SystemExit(
+            "--packed requires --paged (the packed step routes every token "
+            "through the paged pool's block tables)"
+        )
     elif args.kv_dtype != "bf16":
         p_err = "--kv-dtype int8 requires --paged (the contiguous cache is unquantized)"
         raise SystemExit(p_err)
@@ -311,6 +337,8 @@ def main(argv=None) -> int:
             "--spec requires --paged (the verify window writes through the "
             "paged engine's block tables)"
         )
+    if args.token_budget and not args.packed:
+        raise SystemExit("--token-budget only applies with --packed")
     mesh = None
     if args.tp > 1:
         from relora_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -373,6 +401,7 @@ def main(argv=None) -> int:
                 engine,
                 prefix_cache=not args.no_prefix_cache,
                 spec=args.spec,
+                packed=args.packed,
                 **common,
             )
         return ContinuousBatchingScheduler(engine, **common)
@@ -394,13 +423,14 @@ def main(argv=None) -> int:
         )
         if not args.no_warmup:
             logger.info("warming serving compiles (disable with --no-warmup)")
-            report = engine.warmup(args.max_batch)
+            report = engine.warmup(args.max_batch, packed=args.packed)
             timings = ", ".join(
                 f"{c['fn']} {c['duration_s']:.2f}s" for c in report["compiles"]
             )
+            buckets = report.get("packed_buckets") or report["prompt_buckets"]
             logger.info(
                 f"warmup compiled {report['n_compiles']} programs "
-                f"(prompt buckets {report['prompt_buckets']}, "
+                f"({'packed' if args.packed else 'prompt'} buckets {buckets}, "
                 f"decode batch {report['batch']}): {timings}"
             )
             if metrics is not None:
@@ -408,6 +438,7 @@ def main(argv=None) -> int:
                     "warmup",
                     batch=report["batch"],
                     prompt_buckets=report["prompt_buckets"],
+                    packed_buckets=report.get("packed_buckets", []),
                     n_compiles=report["n_compiles"],
                 )
         # preload AFTER warmup: the warmup pass writes a zero adapter into

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Eight rules, each skipped gracefully when its input files are absent:
+Nine rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -34,7 +34,13 @@ Eight rules, each skipped gracefully when its input files are absent:
    committed ``spec_accept_rate_floor`` and its effective tok/s within
    ``--tolerance`` of the non-speculative "off" level.  Skipped off-TPU —
    CPU timings and random-token bench prompts carry no speculation signal.
-8. **grouped LoRA** (``BENCH_lora.json`` ``detail.grouped_buckets``): on TPU
+8. **packed step** (``BENCH_http.json`` ``detail.packed_run``): the packed
+   token-budget run must issue exactly one model dispatch per scheduler
+   round, and on TPU its peak-level ``ttft_p95_ms`` must stay within
+   ``--tolerance`` of the sequential headline — packing decode and prefill
+   into one forward must not starve first tokens.  The latency half is
+   skipped off-TPU.
+9. **grouped LoRA** (``BENCH_lora.json`` ``detail.grouped_buckets``): on TPU
    the grouped multi-tenant arm on a degenerate single-adapter batch
    (``distinct_adapters == 1``) must stay within ``--tolerance`` of the
    single-adapter fused arm on the same (B, K, N, r) bucket — the grouped
@@ -300,6 +306,54 @@ def check_spec(
     return failures
 
 
+def check_packed(bench_dir: str, tolerance: float) -> List[str]:
+    """Packed-step rule over ``detail.packed_run`` in BENCH_http.json
+    (present for paged ``--mode serve_load`` runs unless
+    ``BENCH_HTTP_PACKED_STEP=0``):
+
+    - the packed run's peak-level ``ttft_p95_ms`` must stay within
+      ``tolerance`` of the sequential headline's peak level — token-budget
+      scheduling exists to cut dispatch overhead, not to starve first
+      tokens behind decode work;
+    - the packed run must actually pack: ``dispatches_per_round`` must be
+      1.0 (one model dispatch per scheduler round is the whole point).
+
+    The latency comparison is skipped off-TPU (like ``check_attn``): CPU
+    wall times carry no performance signal.  The dispatches-per-round
+    structural rule runs everywhere — it counts calls, not time.
+    """
+    doc = _load(os.path.join(bench_dir, "BENCH_http.json"))
+    detail = (doc or {}).get("detail") or {}
+    packed = detail.get("packed_run") or {}
+    if not packed:
+        return []
+    failures = []
+    dpr = (packed.get("dispatch") or {}).get("dispatches_per_round")
+    if isinstance(dpr, (int, float)) and dpr > 1.0:
+        failures.append(
+            f"packed: {dpr:.2f} model dispatches per round — the packed "
+            "scheduler must issue exactly one dispatch per round"
+        )
+    if "cpu" in str(detail.get("device", "")).lower():
+        return failures  # off-TPU: no latency signal
+    levels = detail.get("levels") or []
+    seq_peak = max(
+        (lv for lv in levels if isinstance(lv.get("ttft_p95_ms"), (int, float))),
+        key=lambda lv: lv.get("throughput_tokens_per_s", 0),
+        default=None,
+    )
+    got = packed.get("ttft_p95_ms_at_peak")
+    base = seq_peak.get("ttft_p95_ms") if seq_peak else None
+    if isinstance(got, (int, float)) and isinstance(base, (int, float)):
+        if got > base * (1.0 + tolerance):
+            failures.append(
+                f"packed: ttft_p95_ms {got:.1f}ms at peak is "
+                f"{(got / base - 1) * 100:.0f}% above the sequential headline "
+                f"{base:.1f}ms (tolerance {tolerance * 100:.0f}%)"
+            )
+    return failures
+
+
 def check_grouped_lora(bench_dir: str, tolerance: float) -> List[str]:
     """Grouped multi-tenant LoRA rule over ``detail.grouped_buckets`` in
     BENCH_lora.json: with every row on one adapter (G=1), the grouped
@@ -381,6 +435,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_obs(args.dir)
         + check_attn(args.dir, args.tolerance)
         + check_spec(args.dir, baselines, args.tolerance)
+        + check_packed(args.dir, args.tolerance)
         + check_grouped_lora(args.dir, args.tolerance)
     )
 

@@ -10,8 +10,9 @@ additional metrics.jsonl streams (e.g. a trainer run dir) with ``--join``.
 Sections:
 
 1. fleet health — per source: last ``up`` sample, staleness, queue depth;
-2. replica comparison — p95 TTFT/TPOT, error rate, token throughput per
-   source over the comparison window (spot the slow or erroring replica);
+2. replica comparison — p95 TTFT/TPOT, error rate, token throughput,
+   tokens per model dispatch, and prefill stall share per source over the
+   comparison window (spot the slow, erroring, or under-packed replica);
 3. SLO / error budget — burn status per objective from a fresh SLOEngine
    pass over the rebuilt store (``--slo-config`` mirrors the collector's);
 4. timeline — health flips, supervisor lifecycle events, SLO burn alerts
@@ -46,6 +47,8 @@ _COMPARE_COLUMNS = (
     ("spec_acc", "spec_accept_rate", 1.0, "{:.3f}"),
     ("adpt_churn", "adapter_churn", 1.0, "{:.2f}"),
     ("adpt_hit", "relora_serve_adapter_hit_rate", 1.0, "{:.3f}"),
+    ("tok_disp", "tokens_per_dispatch", 1.0, "{:.1f}"),
+    ("stall", "relora_serve_prefill_stall_share", 1.0, "{:.3f}"),
 )
 
 _TIMELINE_KINDS = (

@@ -15,8 +15,9 @@ Joins the three telemetry streams the obs layer produces into the answer to
    the signature diff of any retrace.
 4. **Serving utilization** — ``serve/batch_fill`` and prefill-stall share
    when the run dir came from the scheduler; paged runs add KV-pool pressure
-   (``serve/kv_pages_used``/``free``), prefix-cache hit rate, and the
-   chunked-prefill padding share.
+   (``serve/kv_pages_used``/``free``), prefix-cache hit rate, the
+   chunked-prefill padding share, and dispatch economics (dispatches per
+   round, tokens per dispatch, packed-token utilization).
 5. **Span phases** — p50/p95 per phase from a ``train_spans.jsonl`` stream
    (``--traces``, or auto-detected next to the run dir).
 6. **BENCH trajectory** — committed ``BENCH_*.json`` context (``--bench-dir``).
@@ -187,6 +188,16 @@ def print_serving(records: List[Dict[str, Any]], out) -> None:
         f"  max {max(fills) * 100:5.1f}%\n"
         f"  prefill stall   mean {mean(stalls) * 100:5.1f}% of step time\n"
     )
+    # dispatch economics: the ratios are cumulative-over-the-run gauges, so
+    # the last record carries the run's answer (1.00/round = fully packed)
+    disp_steps = [r for r in steps if "serve/dispatches_per_round" in r]
+    if disp_steps:
+        last = disp_steps[-1]
+        out.write(
+            f"  dispatches      {last['serve/dispatches_per_round']:.2f} per round"
+            f"  {last.get('serve/tokens_per_dispatch', 0.0):.1f} tokens each"
+            f"  ({last.get('serve/packed_token_utilization', 0.0) * 100:.1f}% real)\n"
+        )
     _print_adapters(steps, out)
     # paged-KV pool pressure (PagedContinuousBatchingScheduler runs only)
     paged_steps = [r for r in steps if "serve/kv_pages_used" in r]
