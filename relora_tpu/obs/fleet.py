@@ -540,11 +540,21 @@ class FleetCollector:
 
     # -- supervisor integration ---------------------------------------------
 
-    def record_supervisor_event(self, event: str, replica_idx: Optional[int], detail: str) -> None:
-        """`ReplicaSupervisor.on_event` adapter: restarts, quarantines, and
-        rolling-drain steps become store events on the fleet timeline."""
-        source = f"r{replica_idx}" if replica_idx is not None else "supervisor"
-        self.store.add_event(f"supervisor_{event}", source, detail=detail)
+    def record_supervisor_event(self, event: str, replica_idx, detail: str) -> None:
+        """`ReplicaSupervisor.on_event` adapter: restarts, quarantines,
+        rolling-drain steps, and deployment transitions become store events
+        on the fleet timeline.  ``deploy_*`` events (the rolling updater's
+        lifecycle) keep their own namespace; everything else gets the
+        ``supervisor_`` prefix.  ``replica_idx`` may be an int index or an
+        rid string ("r0"); None means the fleet as a whole."""
+        if replica_idx is None:
+            source = "supervisor"
+        elif isinstance(replica_idx, int):
+            source = f"r{replica_idx}"
+        else:
+            source = str(replica_idx)
+        kind = event if event.startswith("deploy_") else f"supervisor_{event}"
+        self.store.add_event(kind, source, detail=detail)
 
     # -- background loop ----------------------------------------------------
 

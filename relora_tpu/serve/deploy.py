@@ -1,0 +1,542 @@
+"""Continuous deployment: checkpoint watcher, fleet hot-swap, canary gate.
+
+The train->merge->serve pipeline's last mile.  Training continuously emits
+servable full-rank checkpoints (every ReLoRA merge boundary); this module
+moves them into a running fleet with zero downtime and a way back:
+
+- ``publish_latest`` / ``read_latest`` — an atomically-replaced ``latest``
+  pointer file next to the checkpoints.  The trainer publishes it from the
+  manifest-finalizing fence (train/checkpoint.py), so the pointer only ever
+  names manifest-committed dirs; a torn write leaves the old pointer intact.
+- ``CheckpointWatcher`` — polls the pointer and hands *verified* checkpoint
+  dirs to a callback.  The size+crc32 manifest check
+  (utils/integrity.verify_checkpoint_files) runs before the callback ever
+  sees a path: the watcher never acts on an unverified or torn dir.
+- ``RollingUpdater`` — one-replica-at-a-time fleet hot-swap over the
+  server's ``POST /admin/reload`` seam: reload, health-probe until the new
+  ``weights_version`` reports healthy, replay a canary prompt-set requiring
+  token-identical greedy output, then the next replica.  Any failure rolls
+  the *whole fleet* back to the previous version (the LossSpikeDetector
+  shape, with the fleet as the trainer and the last healthy version as the
+  rollback checkpoint), and every transition lands as a ``deploy_*`` event
+  in the fleet SeriesStore timeline.
+
+Everything here is stdlib-only and jax-free: the watcher and updater run in
+supervisor/front-end processes that must never pay a device runtime import.
+
+Drill sites (utils/faults.py): ``deploy_corrupt_manifest`` (publish flips a
+byte in the checkpoint's manifest), ``deploy_reload`` (the server's apply
+boundary raises), ``deploy_crash_mid_update`` (the updater dies between
+replicas).  ``tests/test_deploy.py`` and smoke stage 14 drive all three to
+a healthy fleet on one consistent version.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from relora_tpu.utils import faults
+from relora_tpu.utils.integrity import MANIFEST_FILE, verify_checkpoint_files
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+LATEST_FILE = "latest"
+
+# default canary prompt-set: tiny token-id prompts every model config can
+# decode; real deployments pass their own (tokenized) prompts
+DEFAULT_CANARY_PROMPTS: Tuple[Tuple[int, ...], ...] = ((1, 2, 3), (4, 5, 6, 7), (2,))
+CANARY_FILE = "canary.json"
+
+
+def checkpoint_step(path: str) -> Optional[int]:
+    """The step encoded in a ``model_{step}`` dir name, or None.  Doubles as
+    the monotonic ``weights_version`` for that checkpoint fleet-wide."""
+    base = os.path.basename(os.path.normpath(path))
+    prefix, _, step = base.rpartition("_")
+    if prefix.startswith("model") and step.isdigit():
+        return int(step)
+    return None
+
+
+def publish_latest(save_dir: str, path: str) -> str:
+    """Atomically point ``save_dir/latest`` at checkpoint ``path``.
+
+    tmp + ``os.replace`` — a reader sees the old pointer or the new one,
+    never a torn file.  Call only for manifest-committed dirs (the trainer
+    publishes from the manifest-finalizing fence; the CLI verifies first).
+    Returns the pointer path."""
+    pointer = os.path.join(save_dir, LATEST_FILE)
+    record = {
+        "path": os.path.basename(os.path.normpath(path)),
+        "step": checkpoint_step(path),
+        "published_unix": time.time(),
+    }
+    tmp = pointer + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, pointer)
+    logger.info(f"published latest -> {record['path']}")
+    if faults.should("deploy_corrupt_manifest"):
+        # drill: the published checkpoint's manifest gets a flipped byte —
+        # watchers must reject the dir and the fleet must hold its version
+        manifest = os.path.join(path, MANIFEST_FILE)
+        try:
+            with open(manifest, "r+b") as f:
+                byte = f.read(1)
+                f.seek(0)
+                f.write(bytes([byte[0] ^ 0xFF]) if byte else b"X")
+            logger.warning(f"fault deploy_corrupt_manifest: corrupted {manifest}")
+        except OSError as e:
+            logger.warning(f"fault deploy_corrupt_manifest could not corrupt: {e}")
+    return pointer
+
+
+def read_latest(save_dir: str) -> Optional[str]:
+    """The checkpoint dir the ``latest`` pointer names (absolute), or None
+    when there is no pointer / it is unreadable (a torn pointer is treated
+    as absent, never as an error — the previous poll's answer stands)."""
+    pointer = os.path.join(save_dir, LATEST_FILE)
+    try:
+        with open(pointer) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    name = record.get("path")
+    if not isinstance(name, str) or not name or os.sep in name:
+        return None
+    return os.path.abspath(os.path.join(save_dir, name))
+
+
+class CheckpointWatcher:
+    """Polls ``save_dir/latest`` and hands each *new, verified* checkpoint
+    dir to ``on_new(path)``.
+
+    The verification gate is absolute: ``on_new`` never sees a dir that
+    failed the manifest check.  A rejected dir is remembered by its manifest
+    signature (mtime+size) so the poll loop does not re-crc an unchanged bad
+    dir every interval, but a re-publish (or a repaired manifest) is
+    re-verified from scratch.  ``on_reject(path, reason)`` is optional
+    telemetry for the reject path.
+    """
+
+    def __init__(
+        self,
+        save_dir: str,
+        on_new: Callable[[str], None],
+        *,
+        interval_s: float = 2.0,
+        verify: Callable[[str], Tuple[bool, str]] = verify_checkpoint_files,
+        on_reject: Optional[Callable[[str, str], None]] = None,
+        current: Optional[str] = None,
+    ):
+        self.save_dir = save_dir
+        self.on_new = on_new
+        self.on_reject = on_reject
+        self.interval_s = interval_s
+        self.verify = verify
+        # the dir currently serving (startup checkpoint): the watcher only
+        # fires for pointers that differ from it
+        self._current = os.path.abspath(current) if current else None
+        self._rejected: Optional[Tuple[str, Any]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _signature(self, path: str) -> Tuple[str, Any]:
+        manifest = os.path.join(path, MANIFEST_FILE)
+        try:
+            st = os.stat(manifest)
+            return path, (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return path, None
+
+    def poll_once(self) -> Optional[str]:
+        """One poll: returns the newly accepted checkpoint path, or None."""
+        target = read_latest(self.save_dir)
+        if target is None or target == self._current:
+            return None
+        sig = self._signature(target)
+        if sig == self._rejected:
+            return None  # same bad dir, unchanged since the last reject
+        ok, reason = self.verify(target)
+        if not ok:
+            self._rejected = sig
+            logger.warning(f"checkpoint watcher: rejecting {target}: {reason}")
+            if self.on_reject is not None:
+                self.on_reject(target, reason)
+            return None
+        self._rejected = None
+        logger.info(f"checkpoint watcher: verified new checkpoint {target}")
+        if self.on_new(target) is False:
+            # the rollout reported failure (no live replicas yet, reload
+            # refused, canary rollback): leave ``_current`` unlatched so the
+            # next poll retries — a transient failure heals on its own, a
+            # persistent one surfaces as repeated deploy_* events
+            return None
+        self._current = target
+        return target
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:
+                # the watch loop must survive a callback blowing up — the
+                # next publish still deserves a chance
+                logger.error(f"checkpoint watcher poll failed: {e!r}")
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-watcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# rolling fleet update
+
+
+def _http_json(
+    host: str,
+    port: int,
+    method: str,
+    path: str,
+    body: Optional[dict] = None,
+    timeout: float = 120.0,
+) -> Tuple[int, dict]:
+    """One request against a replica; returns (status, parsed body).  The
+    server speaks close-delimited HTTP/1.1, so a fresh connection per call."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(
+            method, path, body=payload,
+            headers={"Content-Type": "application/json"} if payload else {},
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return resp.status, {}
+    finally:
+        conn.close()
+
+
+class CanaryMismatch(Exception):
+    """A replica's greedy canary output diverged from the recorded baseline."""
+
+
+class _ReplicaUpdateFailed(Exception):
+    """A replica's reload or post-reload health probe failed mid-rollout."""
+
+
+class RollingUpdater:
+    """Drain-free rolling weight update with a canary gate and automatic
+    fleet-wide rollback.
+
+    ``endpoints`` is a zero-arg callable returning ``{idx: (host, port)}``
+    (``ReplicaSupervisor.endpoints``); ``emit(event, idx, detail)`` forwards
+    ``deploy_*`` lifecycle events (wired to the fleet SeriesStore by the
+    supervisor CLI).  One replica at a time: reload via ``/admin/reload``
+    (the server itself fences the swap between decode rounds, so in-flight
+    requests are never dropped), health-probe until the replica reports the
+    new ``weights_version`` with status ok, then replay the canary prompts
+    requiring token-identical greedy output against the baseline *for the
+    new version* — loaded from ``<ckpt>/canary.json`` when the trainer
+    recorded one, else recorded from the first updated replica (which makes
+    that replica the canary and pins the rest of the fleet to bit-identical
+    behavior).  Any reload/probe/canary failure rolls every replica back to
+    the previous version and reports False.
+    """
+
+    def __init__(
+        self,
+        endpoints: Callable[[], Dict[int, Tuple[str, Optional[int]]]],
+        *,
+        canary_prompts: Optional[List[List[int]]] = None,
+        canary_max_new_tokens: int = 8,
+        expect_replicas: Optional[int] = None,
+        emit: Optional[Callable[[str, Optional[int], dict], None]] = None,
+        probe_timeout_s: float = 120.0,
+        probe_interval_s: float = 0.2,
+        request_timeout_s: float = 120.0,
+        verify: Callable[[str], Tuple[bool, str]] = verify_checkpoint_files,
+    ):
+        self.endpoints = endpoints
+        self.canary_prompts = [
+            list(p) for p in (canary_prompts or DEFAULT_CANARY_PROMPTS)
+        ]
+        self.canary_max_new_tokens = canary_max_new_tokens
+        self.expect_replicas = expect_replicas
+        self._emit_cb = emit
+        self.probe_timeout_s = probe_timeout_s
+        self.probe_interval_s = probe_interval_s
+        self.request_timeout_s = request_timeout_s
+        self.verify = verify
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _emit(self, event: str, idx: Optional[int], **detail: Any) -> None:
+        logger.info(f"{event} replica={idx} {detail}")
+        if self._emit_cb is not None:
+            try:
+                self._emit_cb(event, idx, detail)
+            except Exception as e:
+                logger.warning(f"deploy event sink failed: {e!r}")
+
+    def _live_endpoints(self) -> Dict[int, Tuple[str, int]]:
+        return {
+            idx: (host, port)
+            for idx, (host, port) in sorted(self.endpoints().items())
+            if port is not None
+        }
+
+    def _healthz(self, host: str, port: int) -> dict:
+        try:
+            _, body = _http_json(host, port, "GET", "/healthz", timeout=10.0)
+            return body
+        except OSError:
+            return {}
+
+    def _probe_until(self, idx: int, host: str, port: int, version: int) -> bool:
+        """Wait for the replica to report status ok on the given version."""
+        deadline = time.monotonic() + self.probe_timeout_s
+        while time.monotonic() < deadline:
+            h = self._healthz(host, port)
+            if h.get("status") == "ok" and h.get("weights_version") == version:
+                return True
+            time.sleep(self.probe_interval_s)
+        return False
+
+    def _generate(self, host: str, port: int, prompt: List[int]) -> List[int]:
+        status, body = _http_json(
+            host, port, "POST", "/v1/generate",
+            {
+                "prompt": prompt,
+                "max_new_tokens": self.canary_max_new_tokens,
+                "temperature": 0.0,  # greedy: token-identical is meaningful
+                "stream": False,
+            },
+            timeout=self.request_timeout_s,
+        )
+        if status != 200 or body.get("finish_reason") not in ("eos", "length"):
+            raise CanaryMismatch(
+                f"canary request failed on replica port {port}: "
+                f"HTTP {status} {body.get('finish_reason') or body.get('error')}"
+            )
+        return list(body.get("tokens") or [])
+
+    def _reload(self, host: str, port: int, path: str) -> Tuple[bool, dict]:
+        try:
+            status, body = _http_json(
+                host, port, "POST", "/admin/reload", {"checkpoint": path},
+                timeout=self.request_timeout_s,
+            )
+        except OSError as e:
+            return False, {"error": f"{e!r}"}
+        return status == 200 and bool(body.get("ok")), body
+
+    def _load_baseline(self, path: str) -> Optional[List[List[int]]]:
+        """Trainer-recorded canary baseline for this checkpoint, if any."""
+        canary = os.path.join(path, CANARY_FILE)
+        try:
+            with open(canary) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        prompts = record.get("prompts")
+        tokens = record.get("tokens")
+        if not isinstance(prompts, list) or not isinstance(tokens, list):
+            return None
+        self.canary_prompts = [list(p) for p in prompts]
+        if isinstance(record.get("max_new_tokens"), int):
+            self.canary_max_new_tokens = record["max_new_tokens"]
+        return [list(t) for t in tokens]
+
+    def _run_canary(
+        self, idx: int, host: str, port: int, baseline: Optional[List[List[int]]]
+    ) -> List[List[int]]:
+        """Replay the canary prompts; raises CanaryMismatch on divergence.
+        Returns the outputs (the recorded baseline for the first replica)."""
+        outs = [self._generate(host, port, p) for p in self.canary_prompts]
+        if baseline is not None:
+            for i, (got, want) in enumerate(zip(outs, baseline)):
+                if got != want:
+                    raise CanaryMismatch(
+                        f"replica {idx} canary prompt {i} diverged: "
+                        f"got {got}, baseline {want}"
+                    )
+        return outs
+
+    # -- the rolling update --------------------------------------------------
+
+    def run(self, new_path: str) -> bool:
+        """Roll the fleet onto ``new_path``.  True on full success; False
+        after an automatic rollback (or when there is nothing to do)."""
+        new_path = os.path.abspath(new_path)
+        ok, reason = self.verify(new_path)
+        if not ok:
+            # belt and braces: the watcher already verifies, but run() is
+            # also a public entry point (CLI, supervisor signal)
+            self._emit("deploy_reject", None, checkpoint=new_path, reason=reason)
+            return False
+        version = checkpoint_step(new_path)
+        eps = self._live_endpoints()
+        if not eps or (self.expect_replicas and len(eps) < self.expect_replicas):
+            # a partially-booted fleet must not be walked: updating only the
+            # visible replicas would latch a mixed-version fleet.  Reporting
+            # failure leaves the watcher unlatched, so the rollout retries
+            # once the whole fleet is up.
+            self._emit(
+                "deploy_reject", None, checkpoint=new_path,
+                reason=f"{len(eps)}/{self.expect_replicas or '?'} replicas live",
+            )
+            return False
+        # what is the fleet serving right now?  A crashed previous update
+        # leaves mixed versions, so look at every replica: replicas already
+        # on the target still get re-walked (reload is idempotent), and the
+        # rollback target must come from a replica NOT yet on the target —
+        # reading it off an updated one would make rollback a no-op
+        states = {idx: self._healthz(host, port) for idx, (host, port) in eps.items()}
+        on_target = [
+            idx
+            for idx, h in states.items()
+            if h.get("weights_checkpoint")
+            and os.path.abspath(h["weights_checkpoint"]) == new_path
+        ]
+        if len(on_target) == len(eps):
+            return True  # whole fleet already on this checkpoint
+        prev_version, prev_path = None, None
+        for idx, h in states.items():
+            ck = h.get("weights_checkpoint")
+            if ck and os.path.abspath(ck) != new_path:
+                prev_version, prev_path = h.get("weights_version"), ck
+                break
+        if version is None:
+            version = (prev_version or 0) + 1
+        self._emit(
+            "deploy_begin", None,
+            checkpoint=new_path, version=version,
+            prev_version=prev_version, replicas=len(eps),
+        )
+        baseline = self._load_baseline(new_path)
+        recorded = baseline is not None
+        updated: List[int] = []
+        try:
+            for idx, (host, port) in eps.items():
+                ok, body = self._reload(host, port, new_path)
+                if not ok:
+                    self._emit(
+                        "deploy_reload_failed", idx,
+                        checkpoint=new_path, error=body.get("error", f"{body}"),
+                    )
+                    raise _ReplicaUpdateFailed("reload failed")
+                if not self._probe_until(idx, host, port, version):
+                    self._emit(
+                        "deploy_probe_failed", idx,
+                        checkpoint=new_path, version=version,
+                    )
+                    raise _ReplicaUpdateFailed("health probe failed")
+                outs = self._run_canary(idx, host, port, baseline)
+                if baseline is None:
+                    baseline = outs
+                    self._emit(
+                        "deploy_canary_recorded", idx,
+                        version=version, prompts=len(outs),
+                    )
+                updated.append(idx)
+                self._emit("deploy_replica_updated", idx, version=version)
+                # drill: die between replicas, leaving a mixed-version fleet
+                # for the recovery path to converge
+                faults.crash_point("deploy_crash_mid_update")
+        except CanaryMismatch as e:
+            self._emit("deploy_canary_fail", None, error=f"{e}", updated=len(updated))
+            self._rollback(eps, prev_version, prev_path, from_version=version)
+            return False
+        except _ReplicaUpdateFailed as e:
+            self._emit("deploy_fail", None, error=f"{e}", updated=len(updated))
+            self._rollback(eps, prev_version, prev_path, from_version=version)
+            return False
+        self._emit(
+            "deploy_complete", None,
+            version=version, checkpoint=new_path,
+            canary_recorded=not recorded, replicas=len(updated),
+        )
+        return True
+
+    def _rollback(
+        self,
+        eps: Dict[int, Tuple[str, int]],
+        prev_version: Optional[int],
+        prev_path: Optional[str],
+        from_version: Optional[int] = None,
+    ) -> None:
+        """Fleet-wide rollback to the previous version — every replica, not
+        just the updated ones, so the fleet always converges to ONE version
+        (a replica that half-applied anything gets re-asserted too)."""
+        if not prev_path:
+            self._emit("deploy_rollback_impossible", None, reason="no previous checkpoint known")
+            return
+        self._emit(
+            "deploy_rollback", None,
+            to_version=prev_version, to_checkpoint=prev_path, from_version=from_version,
+        )
+        for idx, (host, port) in eps.items():
+            h = self._healthz(host, port)
+            if h.get("status") == "ok" and h.get("weights_version") == prev_version:
+                continue  # never updated (or already back): nothing to undo
+            ok, body = self._reload(host, port, prev_path)
+            if not ok:
+                self._emit(
+                    "deploy_rollback_replica_failed", idx,
+                    error=body.get("error", f"{body}"),
+                )
+                continue
+            if self._probe_until(idx, host, port, prev_version):
+                self._emit("deploy_replica_rolled_back", idx, version=prev_version)
+            else:
+                self._emit("deploy_rollback_replica_failed", idx, error="probe timeout")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``python -m relora_tpu.serve.deploy publish <ckpt_dir>`` verifies
+    a checkpoint dir and atomically publishes its save-dir's ``latest``
+    pointer at it (the by-hand twin of the trainer's automatic publish)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    pub = sub.add_parser("publish", help="verify + publish latest -> DIR")
+    pub.add_argument("checkpoint", help="model_{step} checkpoint dir")
+    pub.add_argument(
+        "--force", action="store_true",
+        help="publish even if verification fails (corruption drills only)",
+    )
+    args = ap.parse_args(argv)
+
+    path = os.path.abspath(args.checkpoint)
+    ok, reason = verify_checkpoint_files(path)
+    if not ok and not args.force:
+        print(f"refusing to publish {path}: {reason}")
+        return 1
+    pointer = publish_latest(os.path.dirname(path), path)
+    print(f"published {pointer} -> {os.path.basename(path)} ({reason})")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -129,6 +129,24 @@ def _write_adapter_slot_tree(params, factors, scale, slot):
     return out
 
 
+def _reload_params_tree(params, fresh):
+    """Pure full-tree weight swap: returns ``params`` with every leaf present
+    in ``fresh`` replaced.  Leaves ``fresh`` omits (the multi-tenant adapter
+    slabs, which a checkpoint reload must never clobber) pass through from
+    the live tree.  The live tree is donated, so the swap reuses its HBM
+    buffers instead of doubling resident params mid-serve."""
+    out = {}
+    for key, value in params.items():
+        f = fresh.get(key) if isinstance(fresh, dict) else None
+        if isinstance(value, dict):
+            out[key] = _reload_params_tree(value, f if isinstance(f, dict) else {})
+        elif f is None:
+            out[key] = value
+        else:
+            out[key] = f
+    return out
+
+
 def build_decode_model(
     model_cfg: ModelConfig,
     *,
@@ -361,6 +379,12 @@ class InferenceEngine:
                 "adapter_write", jax.jit(_write_adapter_slot_tree, donate_argnums=(0,))
             )
             self._factor_template = self._adapter_factor_template()
+        # full-tree hot swap (reload_params): the adapter-writer seam scaled
+        # up to the whole merged tree — donated live params, host leaves cast
+        # onto the live dtypes, one compiled program across every reload
+        self._reload = cw.wrap(
+            "params_reload", jax.jit(_reload_params_tree, donate_argnums=(0,))
+        )
 
         if self.paged:
             # a second model instance over the same params: cache variables
@@ -596,6 +620,62 @@ class InferenceEngine:
         wants (serve/adapters.py)."""
         self._require_slots()
         return lambda slot, factors, scale: self.write_adapter_slot(slot, factors, scale)
+
+    # -- in-place weight reload (continuous deployment) ----------------------
+
+    def _prepare_reload_tree(self, live: PyTree, new: PyTree, prefix: str = "params") -> PyTree:
+        """Validate a restored checkpoint tree against the live tree and cast
+        it for the jitted swap: every live leaf must have a same-shape twin
+        (mismatches fail closed with the offending leaf named), dtypes are
+        cast host-side onto the live leaf so every reload presents one
+        abstract signature, and — on adapter-slot engines — incoming LoRA
+        factors are dropped so tenant slabs survive the swap."""
+        if not isinstance(new, dict):
+            raise ValueError(f"reload: expected a subtree at {prefix}, got {type(new).__name__}")
+        extra = set(new) - set(live)
+        if extra:
+            raise ValueError(
+                f"reload: checkpoint leaf {prefix}/{sorted(extra)[0]} does not "
+                "exist in the live tree (wrong model config?)"
+            )
+        out = {}
+        for key, value in live.items():
+            path = f"{prefix}/{key}"
+            if self.adapter_slots and key in (*_LORA_FACTOR_LEAVES, "lora_s"):
+                continue  # tenant slabs: never overwritten by a base reload
+            if isinstance(value, dict):
+                out[key] = self._prepare_reload_tree(value, new.get(key, {}), path)
+                continue
+            if key not in new:
+                raise ValueError(f"reload: checkpoint is missing leaf {path}")
+            f = np.asarray(new[key])
+            if tuple(f.shape) != tuple(value.shape):
+                raise ValueError(
+                    f"reload: shape mismatch at {path}: checkpoint "
+                    f"{tuple(f.shape)} vs live {tuple(value.shape)}"
+                )
+            if f.dtype != value.dtype:
+                f = f.astype(value.dtype)
+            if self.mesh is not None:
+                # place on the live leaf's sharding so the jitted swap never
+                # reshards (and the signature stays placement-stable)
+                f = jax.device_put(f, value.sharding)
+            out[key] = f
+        return out
+
+    def reload_params(self, new_params: PyTree) -> None:
+        """In-place hot swap of the full serving tree — the deployment twin
+        of ``write_adapter_slot``.  ``new_params`` is a restored host tree
+        (``train/checkpoint.restore_serving_params``); shapes are enforced
+        against the live tree before any device write, the live tree is
+        donated (no transient 2x params in HBM), and the jitted swap keeps
+        one signature across reloads, so the CompileWatcher pins zero
+        steady-state retraces under reload churn.  On any validation error
+        the live tree is untouched — the server's fail-closed contract."""
+        fresh = self._prepare_reload_tree(self.params, new_params)
+        self.params = self._reload(self.params, fresh)
+        # surface transfer/execution errors here, not on the next decode
+        jax.block_until_ready(self.params)
 
     def _row_idx(self, adapter_idx, rows: int) -> jax.Array:
         """Normalize an optional per-row adapter index to a concrete (rows,)

@@ -798,6 +798,10 @@ class Router:
             st.breaker.record_success()
             ct = up_headers.get("content-type", "application/octet-stream")
             fwd_headers = {"X-Request-Id": rid, "X-Relora-Replica": st.rid}
+            if "x-relora-weights" in up_headers:
+                # surface which weights version served this response so a
+                # rolling update is observable from outside the fleet
+                fwd_headers["X-Relora-Weights"] = up_headers["x-relora-weights"]
             if "text/event-stream" in ct:
                 # SSE: forward bytes as they arrive.  The head goes out once
                 # (a retry after head-only keeps streaming into the same

@@ -102,6 +102,24 @@ class BadRequest(Exception):
     """Malformed request body — HTTP 400."""
 
 
+class _ReloadRequest:
+    """One pending in-place weight reload, handed to the model thread.
+
+    ``apply`` is the prepared host->device closure (the checkpoint is already
+    verified and restored to host memory when this exists); the model thread
+    runs it at an idle decode boundary and completes ``done`` with ``ok`` /
+    ``error`` filled in.
+    """
+
+    def __init__(self, apply: Callable[[], None], version: int, checkpoint: str):
+        self.apply = apply
+        self.version = version
+        self.checkpoint = checkpoint
+        self.done = threading.Event()
+        self.ok = False
+        self.error: Optional[str] = None
+
+
 def parse_generate_body(
     body: bytes,
     *,
@@ -187,6 +205,9 @@ class GenerateServer:
         error_linger_s: float = 1.0,
         metrics: Optional[MetricsLogger] = None,
         tracer: Optional[Tracer] = None,
+        reload_prepare: Optional[Callable[[str], Callable[[], None]]] = None,
+        weights_version: int = 0,
+        weights_checkpoint: str = "",
     ):
         self.scheduler = scheduler
         self.host = host
@@ -254,6 +275,17 @@ class GenerateServer:
         # status, not just connection-refused) before the process exits
         self.error_linger_s = error_linger_s
         self._tokens_emitted = 0  # model thread only; feeds faults.serve_tick
+        # -- in-place weight reload (continuous deployment) --------------------
+        # reload_prepare(path) runs off the model thread (verify manifest +
+        # restore to host memory) and returns the apply closure the model
+        # thread honors at an idle decode boundary — the PreemptionGuard
+        # "honor at the boundary" shape, with the decode round as boundary
+        self.reload_prepare = reload_prepare
+        self.weights_version = weights_version
+        self.weights_checkpoint = weights_checkpoint
+        self.stats.set_gauge("weights_version", weights_version)
+        self._reload_lock = threading.Lock()
+        self._pending_reload: Optional[_ReloadRequest] = None
         self._last_step_t = time.monotonic()
         self._model_busy = False  # model thread writes; watchdog reads
         self._stuck = False  # watchdog writes; healthz reads
@@ -329,7 +361,14 @@ class GenerateServer:
         try:
             while True:
                 faults.serve_tick(self._tokens_emitted)  # serving drills only
-                while sched.active_slots + sched.queue_depth < sched.max_batch:
+                # a pending reload pauses *claiming* only: queued tickets wait
+                # in admission (nothing is dropped), in-flight requests finish
+                # entirely on the old weights (per-request version purity),
+                # and the swap happens at the idle boundary below
+                reload_req = self._pending_reload
+                while reload_req is None and (
+                    sched.active_slots + sched.queue_depth < sched.max_batch
+                ):
                     ticket = self.admission.pop(timeout=None)
                     if ticket is None:
                         break
@@ -351,6 +390,11 @@ class GenerateServer:
                     continue
                 self._model_busy = False
                 self._last_step_t = time.monotonic()  # idle is not a stall
+                if reload_req is not None:
+                    # the boundary: no active slots, no scheduler queue — swap
+                    # weights now, then resume claiming on the next iteration
+                    self._apply_reload(reload_req)
+                    continue
                 if self.admission.draining and self.admission.depth() == 0:
                     break
                 ticket = self.admission.pop(timeout=_IDLE_POP_S)
@@ -361,6 +405,7 @@ class GenerateServer:
             logger.error(f"model thread died: {e!r}")
             self._fail_pending(e)
         finally:
+            self._fail_reload("model thread exited")
             self.drained.set()
             if self._worker_error is not None and self.error_linger_s > 0:
                 time.sleep(self.error_linger_s)
@@ -420,6 +465,65 @@ class GenerateServer:
                 )
             except Exception as e:
                 logger.warning(f"request {ticket.uid}: finish callback failed: {e!r}")
+
+    # -- in-place weight reload ----------------------------------------------
+
+    def request_reload(self, apply: Callable[[], None], version: int, checkpoint: str) -> _ReloadRequest:
+        """Queue a prepared weight swap for the model thread's next idle
+        boundary.  Thread-safe; raises RuntimeError while another reload is
+        still pending (one swap at a time keeps versions totally ordered)."""
+        req = _ReloadRequest(apply, version, checkpoint)
+        with self._reload_lock:
+            if self._pending_reload is not None:
+                raise RuntimeError("a weight reload is already pending")
+            self._pending_reload = req
+        return req
+
+    def _apply_reload(self, req: _ReloadRequest) -> None:
+        """Model thread, idle boundary: run the prepared swap.  Any failure
+        fails closed — the old weights keep serving, the version does not
+        move, and the error is reported to the requester."""
+        try:
+            faults.maybe_fail("deploy_reload")
+            req.apply()
+        except Exception as e:
+            req.error = f"{e!r}"
+            self.stats.inc("weights_reload_failures_total")
+            logger.error(
+                f"weight reload to {req.checkpoint!r} failed ({e!r}); "
+                f"keeping weights_version {self.weights_version}"
+            )
+            if self.metrics is not None:
+                self.metrics.event(
+                    "serve_reload_failed", checkpoint=req.checkpoint, error=f"{e!r}"
+                )
+        else:
+            req.ok = True
+            self.weights_version = req.version
+            self.weights_checkpoint = req.checkpoint
+            self.stats.inc("weights_reloads_total")
+            self.stats.set_gauge("weights_version", req.version)
+            logger.info(
+                f"weights hot-swapped to version {req.version} ({req.checkpoint})"
+            )
+            if self.metrics is not None:
+                self.metrics.event(
+                    "serve_reload", weights_version=req.version, checkpoint=req.checkpoint
+                )
+        finally:
+            with self._reload_lock:
+                self._pending_reload = None
+            req.done.set()
+
+    def _fail_reload(self, detail: str) -> None:
+        """Complete a still-pending reload with an error so its requester
+        never hangs (model-thread death or drain exit)."""
+        with self._reload_lock:
+            req, self._pending_reload = self._pending_reload, None
+        if req is not None and not req.done.is_set():
+            req.error = detail
+            self.stats.inc("weights_reload_failures_total")
+            req.done.set()
 
     # -- stall watchdog ------------------------------------------------------
 
@@ -567,6 +671,12 @@ class GenerateServer:
                 await _respond_json(writer, 405, {"error": "use POST"})
                 return
             await self._handle_generate(reader, writer, body, headers)
+        elif route == "/admin/reload":
+            self.stats.inc("http_requests_total", ("route", "reload"))
+            if method != "POST":
+                await _respond_json(writer, 405, {"error": "use POST"})
+                return
+            await self._handle_reload(writer, body)
         else:
             self.stats.inc("http_requests_total", ("route", "other"))
             await _respond_json(writer, 404, {"error": f"no route {route}"})
@@ -590,6 +700,11 @@ class GenerateServer:
             "max_queue": self.admission.max_queue,
             "retry_after_s": round(self.admission.retry_after_s, 3),
             "uptime_s": round(time.monotonic() - self._t_start, 3),
+            # numeric, so the fleet collector ingests it as a free
+            # healthz_weights_version series per replica; the checkpoint path
+            # is what a rolling updater reads back for its rollback target
+            "weights_version": self.weights_version,
+            "weights_checkpoint": self.weights_checkpoint,
         }
         if self._worker_error is not None:
             payload["detail"] = f"model thread died: {self._worker_error!r}"
@@ -610,6 +725,72 @@ class GenerateServer:
             if stats is not None:
                 payload["adapters"] = stats
         await _respond_json(writer, status, payload)
+
+    async def _handle_reload(self, writer: asyncio.StreamWriter, body: bytes) -> None:
+        """POST /admin/reload {"checkpoint": path}: verify + restore the
+        checkpoint off the model thread, then hand the swap to the model
+        thread's idle boundary and wait for its verdict.  Every failure mode
+        (no reload path, bad body, verify/restore error, swap error) leaves
+        the old weights serving — the endpoint can only move the version
+        forward on full success."""
+        if self.reload_prepare is None:
+            await _respond_json(
+                writer, 501,
+                {"error": "no reload path configured (start with a --checkpoint)"},
+            )
+            return
+        if self._worker_error is not None:
+            await _respond_json(
+                writer, 503, {"error": f"model thread died: {self._worker_error!r}"}
+            )
+            return
+        try:
+            payload = json.loads(body.decode("utf-8") or "{}")
+            path = payload.get("checkpoint")
+            if not isinstance(path, str) or not path.strip():
+                raise BadRequest('"checkpoint" must be a non-empty path string')
+        except (UnicodeDecodeError, json.JSONDecodeError, BadRequest) as e:
+            await _respond_json(writer, 400, {"error": str(e)})
+            return
+        path = path.strip()
+        from relora_tpu.serve.deploy import checkpoint_step
+
+        version = checkpoint_step(path)
+        if version is None:
+            version = self.weights_version + 1  # non-model_N dirs still order
+        loop = asyncio.get_running_loop()
+        try:
+            # verify manifest + restore to host memory off the event loop AND
+            # off the model thread — decode keeps running while this works
+            apply = await loop.run_in_executor(None, self.reload_prepare, path)
+        except Exception as e:
+            self.stats.inc("weights_reload_failures_total")
+            logger.error(f"reload rejected before any device write: {e!r}")
+            if self.metrics is not None:
+                self.metrics.event("serve_reload_failed", checkpoint=path, error=f"{e!r}")
+            await _respond_json(
+                writer, 422,
+                {"error": f"{e}", "weights_version": self.weights_version},
+            )
+            return
+        try:
+            req = self.request_reload(apply, version, path)
+        except RuntimeError as e:
+            await _respond_json(
+                writer, 409, {"error": str(e), "weights_version": self.weights_version}
+            )
+            return
+        await loop.run_in_executor(None, req.done.wait)
+        await _respond_json(
+            writer,
+            200 if req.ok else 500,
+            {
+                "ok": req.ok,
+                "weights_version": self.weights_version,
+                "weights_checkpoint": self.weights_checkpoint,
+                **({"error": req.error} if req.error else {}),
+            },
+        )
 
     async def _handle_generate(
         self,
@@ -732,7 +913,15 @@ class GenerateServer:
                 200,
                 "OK",
                 "text/event-stream",
-                {"Cache-Control": "no-cache", "X-Request-Id": ticket.trace_id or ""},
+                {
+                    "Cache-Control": "no-cache",
+                    "X-Request-Id": ticket.trace_id or "",
+                    # which weights serve this stream: a canary client can
+                    # assert it hit the post-swap version without a healthz
+                    # round trip (the version cannot change mid-request —
+                    # swaps only happen with zero slots active)
+                    "X-Relora-Weights": str(self.weights_version),
+                },
             )
         )
         await writer.drain()
@@ -794,7 +983,10 @@ class GenerateServer:
                         writer,
                         500 if a.finish_reason == "error" else 200,
                         _completion_record(a),
-                        extra_headers={"X-Request-Id": ticket.trace_id or ""},
+                        extra_headers={
+                            "X-Request-Id": ticket.trace_id or "",
+                            "X-Relora-Weights": str(self.weights_version),
+                        },
                     )
                     return
         finally:

@@ -399,6 +399,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     p.add_argument("--slo-config", default=None, help="JSON SLO config (docs/observability.md)")
     p.add_argument(
+        "--watch-checkpoints",
+        default=None,
+        metavar="DIR",
+        help="continuous deployment: poll DIR/latest (published by the "
+        "trainer at every manifest commit) and roll verified new checkpoints "
+        "across the fleet one replica at a time — canary-gated, automatic "
+        "fleet-wide rollback on any failure (docs/operations.md)",
+    )
+    p.add_argument(
+        "--watch-interval-s", type=float, default=2.0, help="checkpoint watcher poll interval"
+    )
+    p.add_argument(
+        "--canary-prompts",
+        default=None,
+        metavar="FILE",
+        help="canary prompt-set for rolling updates: one token-id prompt per "
+        "line (comma/space-separated ints); default: a built-in tiny set. "
+        "Ignored when the checkpoint ships its own canary.json baseline.",
+    )
+    p.add_argument(
+        "--canary-max-new-tokens",
+        type=int,
+        default=8,
+        help="greedy tokens per canary prompt (token-identical gate)",
+    )
+    p.add_argument(
         "command", nargs=argparse.REMAINDER, help="replica command (after --)"
     )
     args = p.parse_args(argv)
@@ -468,6 +494,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if collector is not None:
         collector.start()
 
+    # continuous deployment: watcher verifies each published checkpoint, the
+    # rolling updater hot-swaps it across the fleet behind the canary gate
+    watcher = None
+    if args.watch_checkpoints:
+        from relora_tpu.serve.deploy import CheckpointWatcher, RollingUpdater
+
+        canary_prompts = None
+        if args.canary_prompts:
+            with open(args.canary_prompts) as f:
+                canary_prompts = [
+                    [int(t) for t in line.replace(",", " ").split()]
+                    for line in f
+                    if line.strip()
+                ]
+
+        def deploy_emit(event: str, idx, detail: Dict) -> None:
+            if collector is not None:
+                collector.record_supervisor_event(event, idx, str(detail))
+
+        updater = RollingUpdater(
+            sup.endpoints,
+            canary_prompts=canary_prompts,
+            canary_max_new_tokens=args.canary_max_new_tokens,
+            expect_replicas=args.replicas,
+            emit=deploy_emit,
+        )
+        watcher = CheckpointWatcher(
+            args.watch_checkpoints,
+            updater.run,
+            interval_s=args.watch_interval_s,
+            on_reject=lambda path, reason: deploy_emit(
+                "deploy_reject", None, {"checkpoint": path, "reason": reason}
+            ),
+        ).start()
+        logger.info(
+            f"continuous deployment armed: watching {args.watch_checkpoints}/latest "
+            f"every {args.watch_interval_s:g}s, canary gate "
+            f"{args.canary_max_new_tokens} greedy tokens"
+        )
+
     def on_sigterm(signum, frame):
         logger.info("SIGTERM: rolling drain, then router shutdown")
 
@@ -496,6 +562,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         asyncio.run(_main())
     finally:
+        if watcher is not None:
+            watcher.stop()
         if collector is not None:
             collector.stop()
         sup.stop()

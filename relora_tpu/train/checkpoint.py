@@ -39,13 +39,12 @@ import json
 import os
 import shutil
 import time
-import zlib
 from typing import Any, Mapping, Optional, Tuple
 
 import jax
 
 from relora_tpu.core.relora import LoraSpec
-from relora_tpu.utils import faults
+from relora_tpu.utils import faults, integrity
 from relora_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -108,12 +107,10 @@ def _array_manifest(state: PyTree) -> dict:
     return out
 
 
-def _file_crc32(path: str) -> int:
-    crc = 0
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            crc = zlib.crc32(chunk, crc)
-    return crc
+# file-level crc lives in utils/integrity.py (jax-free) so the deployment
+# watcher can verify dirs without an accelerator runtime; re-exported here
+# for the manifest writer and existing callers.
+_file_crc32 = integrity.file_crc32
 
 
 def _walk_state_files(path: str) -> dict:
@@ -153,6 +150,12 @@ def _finalize_pending_manifests() -> None:
             json.dump(manifest, f, indent=2)
         os.replace(tmp, os.path.join(path, MANIFEST_FILE))
         logger.info(f"checkpoint manifest committed for {path}")
+        # deployment hook: only manifest-committed checkpoints are eligible
+        # for fleet hot-swap, so the `latest` pointer moves here and nowhere
+        # earlier — a watcher that trusts it never sees a torn dir.
+        from relora_tpu.serve import deploy
+
+        deploy.publish_latest(os.path.dirname(path) or ".", path)
 
 
 def verify_checkpoint(path: str, check_arrays: bool = False) -> Tuple[bool, str]:
@@ -163,27 +166,17 @@ def verify_checkpoint(path: str, check_arrays: bool = False) -> Tuple[bool, str]
     finalizing fence) — commit-detection via ``state/`` still applies.
     ``check_arrays`` additionally cross-checks recorded shapes/dtypes against
     the Orbax metadata (slower; used by tests and offline tools)."""
-    state_path = os.path.join(path, STATE_SUBDIR)
-    if not os.path.isdir(state_path):
-        return False, "uncommitted: no state/ subdir"
-    manifest_path = os.path.join(path, MANIFEST_FILE)
-    if not os.path.exists(manifest_path):
-        return True, "legacy checkpoint without manifest"
-    try:
-        with open(manifest_path) as f:
-            manifest = json.load(f)
-    except (OSError, json.JSONDecodeError) as e:
-        return False, f"unreadable manifest: {e}"
-    for rel, rec in manifest.get("files", {}).items():
-        full = os.path.join(path, rel)
-        if not os.path.exists(full):
-            return False, f"missing file {rel}"
-        size = os.path.getsize(full)
-        if size != rec["size"]:
-            return False, f"size mismatch for {rel}: {size} != {rec['size']}"
-        if _file_crc32(full) != rec["crc32"]:
-            return False, f"checksum mismatch for {rel}"
+    ok, reason = integrity.verify_checkpoint_files(path)
+    if not ok:
+        return ok, reason
     if check_arrays:
+        state_path = os.path.join(path, STATE_SUBDIR)
+        manifest_path = os.path.join(path, MANIFEST_FILE)
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            manifest = {}
         import orbax.checkpoint as ocp
 
         try:
@@ -201,7 +194,7 @@ def verify_checkpoint(path: str, check_arrays: bool = False) -> Tuple[bool, str]
                     f"shape mismatch at {jax.tree_util.keystr(keypath)}: "
                     f"{shape} != {rec['shape']}"
                 )
-    return True, "ok"
+    return True, reason
 
 
 def save_checkpoint(
@@ -334,7 +327,14 @@ def restore_serving_params(path: str) -> PyTree:
     run (no ``relora_config.json``), a live ReLoRA run (factors present —
     merge via the saved spec), and an exported/already-merged tree that still
     carries its ``relora_config.json`` sidecar (no ``lora_a`` leaves — the
-    merge walk passes it through unchanged instead of KeyError-ing)."""
+    merge walk passes it through unchanged instead of KeyError-ing).
+
+    Every call — serve startup and every in-place reload — verifies the
+    size+crc32 manifest first, so a torn or bit-flipped checkpoint is
+    rejected (with the failing file named) before any device write."""
+    ok, reason = verify_checkpoint(path)
+    if not ok:
+        raise ValueError(f"refusing to serve corrupt checkpoint {path}: {reason}")
     params = restore_params_host(path)
     spec = load_lora_spec(path)
     if spec is None:

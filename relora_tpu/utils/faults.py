@@ -35,6 +35,20 @@ Serving sites (hooked by ``serve/server.py``, drilled in
   ``maybe_fail``: the server closes the first ``times`` accepted
   connections without a byte of response (router retry drill).
 
+Deployment sites (hooked by ``serve/deploy.py`` / ``serve/server.py``,
+drilled in ``tests/test_deploy.py`` and smoke stage 14):
+
+- ``should("deploy_corrupt_manifest")`` — the publish path flips a byte in
+  the just-published checkpoint's ``manifest.json``; the watcher must
+  reject the dir and the fleet must stay on its current version.
+- ``maybe_fail("deploy_reload")``      — raise inside the server's
+  apply-reload boundary (``exc=...``); the replica must fail closed and
+  keep serving the old weights.
+- ``crash_point("deploy_crash_mid_update")`` — kill (``code=N`` →
+  ``os._exit``) or abort (``exc=...``) the rolling updater between
+  replicas, leaving the fleet on mixed versions; recovery must converge it
+  back to one consistent version.
+
 Configuration is programmatic (``configure``/``reset``, used by tests) or
 via the ``RELORA_TPU_FAULTS`` env var for CLI runs, e.g.::
 
@@ -172,6 +186,26 @@ def maybe_fail(site: str) -> None:
         return
     _FIRED[site] = _FIRED.get(site, 0) + 1
     exc = spec.get("exc", OSError)
+    raise exc(f"injected fault at {site!r} ({_FIRED[site]}/{times})")
+
+
+def crash_point(site: str) -> None:
+    """Hard-death-or-abort hook for mid-procedure faults (the rolling
+    updater's ``deploy_crash_mid_update``).  With ``code=N`` the process
+    dies via ``os._exit`` — the SIGKILL-shaped drill for subprocess fleets;
+    without it the armed exception is raised — the in-process test form."""
+    spec = _FAULTS.get(site)
+    if spec is None:
+        return
+    times = int(spec.get("times", 1))
+    if _FIRED.get(site, 0) >= times:
+        return
+    _FIRED[site] = _FIRED.get(site, 0) + 1
+    if "code" in spec:
+        code = int(spec["code"])
+        logger.warning(f"fault {site!r}: os._exit({code})")
+        os._exit(code)
+    exc = spec.get("exc", RuntimeError)
     raise exc(f"injected fault at {site!r} ({_FIRED[site]}/{times})")
 
 

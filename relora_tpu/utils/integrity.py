@@ -1,0 +1,62 @@
+"""File-level checkpoint integrity checks, importable without jax.
+
+The checkpoint layer (``train/checkpoint.py``) records a ``manifest.json``
+per committed ``model_{step}`` dir: per-array shapes/dtypes plus per-file
+size+crc32.  Verifying the *file* half of that contract needs nothing from
+jax/orbax — just a directory walk and a crc pass — so it lives here, where
+the deployment plane (``serve/deploy.py``) and the supervisor can use it
+without dragging an accelerator runtime into a watcher process.
+
+``verify_checkpoint_files`` is the single torn/corrupt-dir gate: the serve
+startup path, every in-place reload, and the checkpoint watcher all route
+through it before any device write happens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Tuple
+
+STATE_SUBDIR = "state"
+MANIFEST_FILE = "manifest.json"
+
+
+def file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def verify_checkpoint_files(path: str) -> Tuple[bool, str]:
+    """Integrity-check a checkpoint dir against its size+crc32 manifest.
+
+    Returns ``(ok, reason)``; on failure ``reason`` names the failing file.
+    A dir without a manifest is accepted as a legacy checkpoint (pre-manifest
+    saves, or a run killed before the finalizing fence) — commit-detection
+    via ``state/`` still applies, so a torn async write is always rejected.
+    """
+    state_path = os.path.join(path, STATE_SUBDIR)
+    if not os.path.isdir(state_path):
+        return False, "uncommitted: no state/ subdir"
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(manifest_path):
+        return True, "legacy checkpoint without manifest"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, rec in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(full)
+        if size != rec["size"]:
+            return False, f"size mismatch for {rel}: {size} != {rec['size']}"
+        if file_crc32(full) != rec["crc32"]:
+            return False, f"checksum mismatch for {rel}"
+    return True, "ok"

@@ -682,4 +682,205 @@ grep -q "slo_burn_alert" "$WORK/fleet_report.txt"
 grep -q "supervisor_" "$WORK/fleet_report.txt"   # lifecycle events on the timeline
 head -40 "$WORK/fleet_report.txt"
 
+echo "=== 14. continuous deployment: watcher hot-swap, corrupt reject, canary rollback ==="
+DEPLOY_FLEET="$WORK/deploy_fleet"
+rm -rf "$DEPLOY_FLEET"; mkdir -p "$DEPLOY_FLEET"
+rm -f "$WORK/deploy_router_port"
+# the trainer's manifest commit already published latest -> model_40; prove
+# that, then re-pin to model_32 so the fleet boots one version behind and the
+# watcher has a verified newer checkpoint to roll forward to
+python - "$WORK/relora" <<'EOF'
+import json, sys
+with open(f"{sys.argv[1]}/latest") as f:
+    rec = json.load(f)
+assert rec["path"] == "model_40", f"trainer did not publish latest: {rec}"
+print(f"trainer published latest -> {rec['path']} (step {rec['step']})")
+EOF
+python -m relora_tpu.serve.deploy publish "$WORK/relora/model_32"
+# drill artifacts: a corrupt copy (the watcher must refuse it) and a valid
+# checkpoint shipping a deliberately wrong canary baseline (the canary gate
+# must yank the fleet back)
+rm -rf "$WORK/relora/model_48" "$WORK/relora/model_9924"
+cp -r "$WORK/relora/model_40" "$WORK/relora/model_48"
+cp -r "$WORK/relora/model_24" "$WORK/relora/model_9924"
+python - "$WORK/relora/model_48" "$WORK/relora/model_9924" <<'EOF'
+import json, os, sys
+corrupt, bad_canary = sys.argv[1], sys.argv[2]
+for dirpath, _, names in os.walk(os.path.join(corrupt, "state")):
+    for name in sorted(names):
+        p = os.path.join(dirpath, name)
+        if os.path.getsize(p):
+            with open(p, "r+b") as f:
+                b = f.read(1)
+                f.seek(0)
+                f.write(bytes([b[0] ^ 0xFF]))
+            break
+    else:
+        continue
+    break
+else:
+    raise SystemExit(f"no state file to corrupt under {corrupt}")
+with open(os.path.join(bad_canary, "canary.json"), "w") as f:
+    json.dump({"prompts": [[1, 2, 3]], "tokens": [[255, 255, 255, 255]],
+               "max_new_tokens": 4}, f)
+EOF
+python -m relora_tpu.serve.supervisor --replicas 2 --workdir "$DEPLOY_FLEET" \
+    --router-port 0 --router-port-file "$WORK/deploy_router_port" \
+    --backoff-base-s 0.2 --probe-interval-s 0.1 --fleet-cadence-s 0.2 \
+    --watch-checkpoints "$WORK/relora" --watch-interval-s 0.3 \
+    --canary-max-new-tokens 4 -- \
+    python serve.py --checkpoint "$WORK/relora/model_32" --model_config llama_9m \
+    --max-batch 2 --max-queue 16 --cache-size 64 --eos-id -1 &
+DEPLOY_SUP_PID=$!
+for _ in $(seq 600); do [ -s "$WORK/deploy_router_port" ] && break; sleep 0.2; done
+[ -s "$WORK/deploy_router_port" ] || { echo "router never wrote its port"; kill "$DEPLOY_SUP_PID"; exit 1; }
+python - "$(cat "$WORK/deploy_router_port")" "$DEPLOY_FLEET" "$WORK/relora" <<'EOF'
+import json, subprocess, sys, threading, time, urllib.error, urllib.request
+
+port, fleet, save_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+base = f"http://127.0.0.1:{port}"
+series_path = f"{fleet}/fleet_series.jsonl"
+
+def healthz():
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read().decode())
+
+def wait_healthy(n, tries=600):
+    h = {}
+    for _ in range(tries):
+        h = healthz()
+        if h.get("healthy_replicas", 0) >= n:
+            return
+        time.sleep(0.2)
+    raise SystemExit(f"fleet never reached {n} healthy replicas: {h}")
+
+def deploy_events():
+    out = []
+    try:
+        with open(series_path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail
+                if rec.get("_event", "").startswith("deploy_"):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+def wait_event(name, want_detail="", tries=600):
+    for _ in range(tries):
+        evs = [e for e in deploy_events()
+               if e["_event"] == name and want_detail in e.get("detail", "")]
+        if evs:
+            return evs
+        time.sleep(0.2)
+    raise SystemExit(f"never saw {name} ({want_detail!r}) in the fleet store")
+
+def publish(ckpt, force=False):
+    cmd = [sys.executable, "-m", "relora_tpu.serve.deploy", "publish", ckpt]
+    if force:
+        cmd.append("--force")
+    subprocess.run(cmd, check=True)
+
+# continuous 8-way load for the whole drill; EVERY request must finish
+dropped, lock = [], threading.Lock()
+last_weights = {}  # replica rid -> last X-Relora-Weights it answered with
+stop = threading.Event()
+
+def worker(wid):
+    while not stop.is_set():
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                             "temperature": 0.0, "stream": False}).encode(),
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                body = json.load(resp)
+                rid = resp.headers.get("X-Relora-Replica")
+                weights = resp.headers.get("X-Relora-Weights")
+                if body.get("finish_reason") not in ("eos", "length"):
+                    raise ValueError(f"bad finish: {body}")
+                with lock:
+                    if rid and weights:
+                        last_weights[rid] = weights
+        except Exception as e:
+            with lock:
+                dropped.append(f"worker {wid}: {e!r}")
+            return
+
+def wait_fleet_on(version, tries=600):
+    for _ in range(tries):
+        with lock:
+            vals = dict(last_weights)
+        if len(vals) >= 2 and all(v == str(version) for v in vals.values()):
+            return
+        time.sleep(0.2)
+    raise SystemExit(f"fleet never converged on weights {version}: {last_weights}")
+
+wait_healthy(2)
+workers = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+for t in workers:
+    t.start()
+
+# 1. rolling hot-swap under load: publish model_40; the watcher verifies it
+#    and walks the fleet one replica at a time behind the canary gate
+publish(f"{save_dir}/model_40")
+wait_event("deploy_complete", "model_40")
+wait_fleet_on(40)
+assert not dropped, dropped
+print("rolling hot-swap 32 -> 40 complete, zero dropped requests")
+
+# 2. corrupt publish: the watcher must refuse it and the fleet must hold 40
+publish(f"{save_dir}/model_48", force=True)
+wait_event("deploy_reject", "model_48")
+assert not any("model_48" in e.get("detail", "")
+               for e in deploy_events() if e["_event"] == "deploy_begin"), \
+    "corrupt checkpoint reached the fleet"
+wait_fleet_on(40)
+print("corrupt publish rejected at the watcher, fleet held version 40")
+
+# 3. canary rollback: model_9924 verifies clean but ships a wrong canary
+#    baseline -- the gate must roll the whole fleet back to model_40
+publish(f"{save_dir}/model_9924")
+wait_event("deploy_canary_fail")
+wait_event("deploy_rollback")
+publish(f"{save_dir}/model_40")  # re-pin: end the (by-design) retry loop
+wait_fleet_on(40)
+print("canary mismatch rolled the fleet back to 40")
+
+stop.set()
+for t in workers:
+    t.join()
+assert not dropped, dropped
+h = healthz()
+assert h.get("healthy_replicas", 0) == 2, h
+# crosscheck through the collector: both replicas' scraped healthz agree
+fs = json.load(urllib.request.urlopen(
+    f"{base}/fleet/series?series=healthz_weights_version", timeout=30))
+for rid in ("r0", "r1"):
+    pts = fs["sources"].get(rid, {}).get("healthz_weights_version") or []
+    assert pts and pts[-1][1] == 40.0, (rid, pts[-2:])
+print("deploy drill OK: hot-swap, corrupt reject, and canary rollback "
+      "all converged on one healthy version")
+EOF
+kill -TERM "$DEPLOY_SUP_PID"
+wait "$DEPLOY_SUP_PID"
+# post-mortem: the whole deployment story must be reconstructible from the
+# persisted fleet store alone (and the stale-bench banner must fire on this
+# repo's replayed BENCH rounds)
+python tools/fleet_report.py "$DEPLOY_FLEET/fleet_series.jsonl" --window-s 600 \
+    --events 200 > "$WORK/deploy_report.txt"
+grep -q "deploy_complete" "$WORK/deploy_report.txt"
+grep -q "deploy_reject" "$WORK/deploy_report.txt"
+grep -q "deploy_canary_fail" "$WORK/deploy_report.txt"
+grep -q "deploy_rollback" "$WORK/deploy_report.txt"
+grep -q "BENCH STALENESS" "$WORK/deploy_report.txt"
+grep "deploy_" "$WORK/deploy_report.txt" | head -20
+
 echo "SMOKE OK"

@@ -74,6 +74,21 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--port-file", default=None, help="server: write the bound port here once listening")
     p.add_argument("--no-warmup", action="store_true", help="server: skip compile warmup at startup")
     p.add_argument(
+        "--watch-checkpoints",
+        default=None,
+        metavar="DIR",
+        help="server: poll DIR/latest (published by the trainer at every "
+        "manifest commit) and hot-swap verified new checkpoints in place — "
+        "zero downtime, in-flight requests finish on the old weights "
+        "(docs/operations.md continuous deployment); requires --port",
+    )
+    p.add_argument(
+        "--watch-interval-s",
+        type=float,
+        default=2.0,
+        help="checkpoint watcher poll interval",
+    )
+    p.add_argument(
         "--stall-timeout-s",
         type=float,
         default=0.0,
@@ -249,6 +264,15 @@ def main(argv=None) -> int:
             )
     if args.adapter_dir is not None and not os.path.isdir(args.adapter_dir):
         raise SystemExit(f"--adapter-dir {args.adapter_dir} is not a directory")
+    if args.watch_checkpoints is not None:
+        if args.port is None:
+            raise SystemExit(
+                "--watch-checkpoints hot-swaps a running server and requires --port"
+            )
+        if args.random_init:
+            raise SystemExit(
+                "--watch-checkpoints needs a checkpoint-backed server, not --random-init"
+            )
 
     tokenizer = None
     if args.tokenizer:
@@ -454,10 +478,74 @@ def main(argv=None) -> int:
                 logger.info(f"preloaded adapter {name!r} into slot {slot}")
         scheduler = build_scheduler(metrics)
 
+        from relora_tpu.serve.deploy import CheckpointWatcher, checkpoint_step
+
+        def reload_prepare(path):
+            """Host-side half of a weight hot-swap: verify + restore the new
+            checkpoint off the model thread, return the device-side apply.
+            Raising here fails the reload closed — the server keeps serving
+            the old weights untouched."""
+            if args.no_merge:
+                from relora_tpu.train.checkpoint import verify_checkpoint
+
+                ok, reason = verify_checkpoint(path)
+                if not ok:
+                    raise ValueError(
+                        f"refusing to reload corrupt checkpoint {path}: {reason}"
+                    )
+                spec = load_lora_spec(path)
+                if spec is not None and spec.r != (lora_spec.r if lora_spec else None):
+                    raise ValueError(
+                        f"reload rank mismatch: serving r={lora_spec.r if lora_spec else None}, "
+                        f"{path} has r={spec.r}"
+                    )
+                new_params = restore_params_host(path)
+            else:
+                # restore_serving_params verifies the manifest before reading
+                new_params = restore_serving_params(path)
+            return lambda: engine.reload_params(new_params)
+
+        watcher = None
+
         def ready(server):
+            nonlocal watcher
             if args.port_file:
                 with open(args.port_file, "w") as f:
                     f.write(str(server.port))
+            if args.watch_checkpoints:
+                # standalone self-update: verified new checkpoints from the
+                # watcher go straight through the server's reload fence
+                def on_new(path):
+                    try:
+                        apply = reload_prepare(path)
+                        req = server.request_reload(
+                            apply,
+                            checkpoint_step(path) or server.weights_version + 1,
+                            path,
+                        )
+                    except Exception as e:
+                        logger.error(f"self-update to {path} failed: {e!r}")
+                        return False  # watcher retries on the next poll
+                    req.done.wait()
+                    if req.ok:
+                        logger.info(
+                            f"self-update: now serving {path} "
+                            f"(weights_version {server.weights_version})"
+                        )
+                    else:
+                        logger.error(f"self-update to {path} failed: {req.error}")
+                        return False  # watcher retries on the next poll
+
+                watcher = CheckpointWatcher(
+                    args.watch_checkpoints,
+                    on_new,
+                    interval_s=args.watch_interval_s,
+                    current=args.checkpoint,
+                ).start()
+                logger.info(
+                    f"watching {args.watch_checkpoints}/latest every "
+                    f"{args.watch_interval_s:g}s for verified checkpoints"
+                )
 
         rc = run_server(
             scheduler,
@@ -470,7 +558,17 @@ def main(argv=None) -> int:
             stall_timeout_s=args.stall_timeout_s,
             metrics=metrics,
             ready_cb=ready,
+            reload_prepare=reload_prepare,
+            weights_version=(
+                checkpoint_step(args.checkpoint) if args.checkpoint else None
+            )
+            or 0,
+            weights_checkpoint=os.path.abspath(args.checkpoint)
+            if args.checkpoint
+            else "",
         )
+        if watcher is not None:
+            watcher.stop()
         if metrics is not None:
             metrics.finish()
         return rc
