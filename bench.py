@@ -53,6 +53,19 @@ once SIGKILLing replica 0 mid-run — recording failover/retry counts, typed
 mid-stream errors, hung requests (must be 0), and p95 TTFT for both runs
 under ``detail.router``.
 
+``--mode autoscale`` drives a low→high→low QPS ramp against an elastically
+scaled subprocess fleet: one ``serve.py --random-init`` replica under
+ReplicaSupervisor, the FleetCollector feeding an Autoscaler (min 1, max 2),
+and the health-aware Router in front.  The burst must scale the fleet to 2,
+the quiet tail back to 1, and no accepted request may be dropped across
+either transition.  Records replicas-over-time, per-phase p95 TTFT, and the
+dropped-request count into ``BENCH_http.json`` under
+``detail.autoscale_run`` (merged — an existing serve_load artifact keeps its
+other sections).  Env: BENCH_HTTP_MODEL (default llama_9m),
+BENCH_AS_MAX_BATCH, BENCH_AS_LOW_QPS, BENCH_AS_HIGH_QPS, BENCH_AS_PHASE_S,
+BENCH_AS_NEW_TOKENS.  Runs on any backend, CPU included — the gate's
+zero-drop rule is structural (it counts requests, not time).
+
 ``--mode obs_overhead`` measures what the span tracer (relora_tpu/obs) costs
 on the training hot path: the same tiny jitted train step is driven twice,
 once under a real ``Tracer`` emitting the trainer's per-update spans and once
@@ -996,6 +1009,270 @@ def serve_load_main(router: bool = False) -> None:
     print(json.dumps(result))
 
 
+def autoscale_main() -> None:
+    """--mode autoscale: QPS ramp against an elastically scaled fleet.
+
+    One serve.py replica under ReplicaSupervisor, the FleetCollector feeding
+    an Autoscaler (min 1, max 2), the Router in front.  Three open-loop
+    phases — low, burst, low — then a settle wait; the artifact records the
+    replica timeline, per-phase p95 TTFT, and how many requests were dropped
+    (no terminal response).  tools/bench_gate.py holds dropped at zero and
+    requires both scale transitions to have happened."""
+    import asyncio
+    import tempfile
+    import threading
+    import time
+
+    from relora_tpu.obs.fleet import FleetCollector, SeriesStore
+    from relora_tpu.serve.autoscale import Autoscaler, AutoscalerPolicy
+    from relora_tpu.serve.router import Router
+    from relora_tpu.serve.supervisor import ReplicaSupervisor
+
+    model_name = os.environ.get("BENCH_HTTP_MODEL", "llama_9m")
+    max_batch = int(os.environ.get("BENCH_AS_MAX_BATCH", "2"))
+    max_queue = int(os.environ.get("BENCH_AS_QUEUE", "16"))
+    prompt_len = int(os.environ.get("BENCH_HTTP_PROMPT_LEN", "8"))
+    new_tokens = int(os.environ.get("BENCH_AS_NEW_TOKENS", "8"))
+    low_qps = float(os.environ.get("BENCH_AS_LOW_QPS", "1"))
+    high_qps = float(os.environ.get("BENCH_AS_HIGH_QPS", "12"))
+    phase_s = float(os.environ.get("BENCH_AS_PHASE_S", "8"))
+    settle_s = float(os.environ.get("BENCH_AS_SETTLE_S", "45"))
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="bench_autoscale_")
+    sup = ReplicaSupervisor(
+        [
+            sys.executable, os.path.join(here, "serve.py"),
+            "--model_config", model_name, "--random-init",
+            "--max-batch", str(max_batch), "--max-queue", str(max_queue),
+            "--no-warmup",
+        ],
+        1,
+        workdir,
+        backoff_base_s=0.1,
+        backoff_cap_s=1.0,
+        backoff_jitter=0.0,
+        poll_interval_s=0.05,
+        drain_timeout_s=30.0,
+    )
+    store = SeriesStore()
+    collector = FleetCollector(sup.endpoints, store=store, cadence_s=0.25)
+    sup.on_event = lambda event, idx, detail: collector.record_supervisor_event(
+        event, idx, str(detail)
+    )
+    policy = AutoscalerPolicy(
+        min_replicas=1,
+        max_replicas=2,
+        # TTFT on the CPU bench is dominated by on-demand compiles, not
+        # capacity — park the target high so queue depth drives the ramp
+        ttft_p95_target_s=float(os.environ.get("BENCH_AS_TTFT_TARGET_S", "30")),
+        queue_depth_high=2.0,
+        slot_util_high=0.95,
+        burn_window_s=1.5,
+        idle_window_s=5.0,
+        cooldown_s=3.0,
+    )
+    autoscaler = Autoscaler(policy, sup, store, interval_s=0.25)
+    rtr = Router(
+        sup.endpoints, port=0, probe_interval_s=0.1,
+        retry_backoff_s=0.02, failure_threshold=2, cooldown_s=0.2,
+    )
+    rtr_thread = threading.Thread(
+        target=lambda: asyncio.run(rtr.serve_forever()), daemon=True
+    )
+
+    # replica-count timeline: change points only, seconds since ramp start
+    timeline: list = []
+    t0 = time.monotonic()
+    sampler_stop = threading.Event()
+
+    def sample_replicas() -> None:
+        while not sampler_stop.is_set():
+            n = sup.n_live()
+            if not timeline or timeline[-1][1] != n:
+                timeline.append((round(time.monotonic() - t0, 2), n))
+            sampler_stop.wait(0.1)
+
+    async def one_request(i: int) -> dict:
+        """POST one streamed generate through the router; classify the
+        outcome: ok (finish + [DONE]), rejected (HTTP 429/503 — typed
+        backpressure, not data loss), or dropped (no terminal response)."""
+        body = json.dumps(
+            {
+                "prompt": [(i * 7) % 50 + 2] * prompt_len,
+                "max_new_tokens": new_tokens,
+                "stream": True,
+            }
+        ).encode()
+        t_send = time.perf_counter()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", rtr.port)
+            writer.write(
+                (
+                    "POST /v1/generate HTTP/1.1\r\nHost: bench\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+            status = int((await reader.readline()).split()[1])
+            while (await reader.readline()).strip():
+                pass  # headers
+            ttft, done = None, False
+            if status == 200:
+                buf = b""
+                while True:
+                    chunk = await reader.read(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        raw, buf = buf.split(b"\n\n", 1)
+                        if not raw.startswith(b"data: "):
+                            continue
+                        if raw == b"data: [DONE]":
+                            done = True
+                        elif ttft is None and b'"token"' in raw:
+                            ttft = time.perf_counter() - t_send
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        except (ConnectionError, OSError, IndexError, ValueError):
+            return {"outcome": "dropped", "ttft": None}
+        except asyncio.TimeoutError:
+            return {"outcome": "dropped", "ttft": None}
+        if status == 200 and done:
+            return {"outcome": "ok", "ttft": ttft}
+        if status in (429, 503):
+            return {"outcome": "rejected", "ttft": None}
+        return {"outcome": "dropped", "ttft": None}
+
+    async def drive_phase(name: str, qps: float) -> dict:
+        interval, n = 1.0 / qps, max(1, int(phase_s * qps))
+        tasks = []
+        t_start = time.perf_counter()
+        for i in range(n):
+            delay = i * interval - (time.perf_counter() - t_start)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(
+                asyncio.ensure_future(asyncio.wait_for(one_request(i), 60.0))
+            )
+        results = []
+        for t in tasks:
+            try:
+                results.append(await t)
+            except asyncio.TimeoutError:
+                results.append({"outcome": "dropped", "ttft": None})
+        ttfts = sorted(r["ttft"] for r in results if r["ttft"] is not None)
+        p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] if ttfts else None
+        return {
+            "phase": name,
+            "offered_qps": qps,
+            "sent": len(results),
+            "ok": sum(r["outcome"] == "ok" for r in results),
+            "rejected": sum(r["outcome"] == "rejected" for r in results),
+            "dropped": sum(r["outcome"] == "dropped" for r in results),
+            "ttft_p95_ms": round(p95 * 1e3, 1) if p95 is not None else None,
+            "replicas_at_end": sup.n_live(),
+        }
+
+    phases = []
+    try:
+        sup.start()
+        collector.start()
+        rtr_thread.start()
+        if not rtr.started.wait(30):
+            raise RuntimeError("router failed to start")
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            if sum(st.healthy for st in rtr.replicas.values()) >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(f"fleet never became healthy: {sup.status()}")
+        # pay the single replica's compile buckets outside the timed phases
+        asyncio.run(one_request(0))
+        autoscaler.start()
+        # rebase the clock before the sampler thread starts, so every
+        # change-point is in seconds since ramp start
+        t0 = time.monotonic()
+        timeline.append((0.0, sup.n_live()))
+        threading.Thread(target=sample_replicas, daemon=True).start()
+        phases.append(asyncio.run(drive_phase("low", low_qps)))
+        phases.append(asyncio.run(drive_phase("burst", high_qps)))
+        phases.append(asyncio.run(drive_phase("low_tail", low_qps)))
+        # idle settle: the quiet tail plus cooldown must bring the fleet
+        # back to the floor before the run is scored
+        deadline = time.monotonic() + settle_s
+        while time.monotonic() < deadline and sup.n_live() > 1:
+            time.sleep(0.25)
+    finally:
+        sampler_stop.set()
+        # the settle loop exits the instant n_live drops — record the final
+        # count ourselves, the sampler may have been stopped before its next poll
+        n_final = sup.n_live()
+        if not timeline or timeline[-1][1] != n_final:
+            timeline.append((round(time.monotonic() - t0, 2), n_final))
+        autoscaler.stop()
+        rtr.begin_shutdown()
+        rtr_thread.join(30)
+        collector.stop()
+        sup.stop()
+
+    events = [
+        {
+            "t": round(e.get("_time", 0.0), 2),
+            "event": e.get("_event"),
+            "action": e.get("action"),
+            "reason": e.get("reason"),
+        }
+        for e in store.events()
+        if str(e.get("_event", "")).startswith("autoscale_")
+    ]
+    max_seen = max(n for _, n in timeline)
+    run = {
+        "model": model_name,
+        "max_batch": max_batch,
+        "low_qps": low_qps,
+        "high_qps": high_qps,
+        "phase_s": phase_s,
+        "phases": phases,
+        "replica_timeline": [list(p) for p in timeline],
+        "max_replicas_seen": max_seen,
+        "final_replicas": timeline[-1][1],
+        "scaled_up": max_seen >= 2,
+        "scaled_down": timeline[-1][1] == 1,
+        "dropped_requests": sum(p["dropped"] for p in phases),
+        "autoscale_events": events[-60:],
+    }
+
+    # merge into BENCH_http.json: a prior serve_load artifact keeps its
+    # levels/spec/packed sections, only autoscale_run is replaced
+    out_path = os.path.join(here, "BENCH_http.json")
+    doc = None
+    try:
+        with open(out_path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        doc = None
+    if not isinstance(doc, dict):
+        doc = {
+            "bench": "serve_autoscale",
+            "metric": f"{model_name} elastic fleet 1->2->1 resize under QPS ramp",
+            "value": run["phases"][1]["ok"] if len(run["phases"]) > 1 else 0,
+            "unit": "requests",
+            "detail": {},
+        }
+    doc.setdefault("detail", {})["autoscale_run"] = run
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({"autoscale_run": run}))
+
+
 def lora_kernel_main() -> None:
     """--mode lora_kernel: per-shape step time of the three LoRA composite
     arms (fused pallas / ordered-unfused / merged), plus what the dispatch
@@ -1528,7 +1805,7 @@ if __name__ == "__main__":
     _ap = argparse.ArgumentParser()
     _ap.add_argument(
         "--mode",
-        choices=["train", "decode", "lint", "lora_kernel", "attention", "serve_load", "obs_overhead"],
+        choices=["train", "decode", "lint", "lora_kernel", "attention", "serve_load", "autoscale", "obs_overhead"],
         default="train",
     )
     _ap.add_argument(
@@ -1549,6 +1826,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if _cli.mode == "serve_load":
         serve_load_main(router=_cli.router)
+        sys.exit(0)
+    if _cli.mode == "autoscale":
+        autoscale_main()
         sys.exit(0)
     if _cli.mode == "lora_kernel":
         lora_kernel_main()

@@ -332,7 +332,8 @@ class FleetCollector:
     1. GET ``/healthz``: ``up`` (1.0 iff HTTP 200), numeric payload fields
        (queue_depth, active_slots, ...), status-flip events.
     2. GET ``/metrics``: gauges/counters via :func:`parse_prometheus`;
-       histograms become ``<name>_p50``/``<name>_p95`` series; counters
+       histograms become ``<name>_p50``/``<name>_p95`` series from
+       per-round bucket deltas (quiet rounds emit no sample); counters
        become ``<name>_per_s`` rate series from deltas; serve-style
        ``requests_finished_total`` reasons collapse into an ``error_rate``
        series.  Router group-health gauges flip into events.
@@ -363,6 +364,7 @@ class FleetCollector:
         self.metrics = MetricsRegistry(namespace="relora_fleet")
         self._jsonl_offsets: Dict[str, int] = {}
         self._prev_counters: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        self._prev_hist_buckets: Dict[Tuple[str, str], Dict[float, float]] = {}
         self._last_status: Dict[str, str] = {}
         self._last_gauges: Dict[Tuple[str, str], float] = {}
         self._thread: Optional[threading.Thread] = None
@@ -506,8 +508,28 @@ class FleetCollector:
                     evictions=evict_delta, slots_used=slots_used,
                 )
         for name, h in hists.items():
-            values[f"{name}_p50"] = histogram_quantile(h["buckets"], 0.50)
-            values[f"{name}_p95"] = histogram_quantile(h["buckets"], 0.95)
+            # Quantiles of the *recent* distribution, from bucket deltas
+            # between scrape rounds.  The exposition is cumulative over the
+            # replica's lifetime; a lifetime p95 never recovers from one
+            # compile storm, which would latch the autoscaler's burn signal
+            # above target long after traffic has drained.  A round with no
+            # new observations emits no sample at all (the series goes
+            # quiet rather than repeating a stale value), so windowed
+            # readers like AutoscalerPolicy see only live traffic.
+            prev_b = self._prev_hist_buckets.get((source, name))
+            self._prev_hist_buckets[(source, name)] = {
+                le: c for le, c in h["buckets"]
+            }
+            if prev_b is None:
+                delta = h["buckets"]  # first scrape: lifetime is the window
+            else:
+                delta = [
+                    (le, max(0.0, c - prev_b.get(le, 0.0)))
+                    for le, c in h["buckets"]
+                ]
+            if delta and max(c for _, c in delta) > 0:
+                values[f"{name}_p50"] = histogram_quantile(delta, 0.50)
+                values[f"{name}_p95"] = histogram_quantile(delta, 0.95)
 
     def _tail_jsonl(self, source: str, path: str) -> None:
         """Incrementally ingest new complete lines of a metrics.jsonl file.
@@ -544,16 +566,21 @@ class FleetCollector:
         """`ReplicaSupervisor.on_event` adapter: restarts, quarantines,
         rolling-drain steps, and deployment transitions become store events
         on the fleet timeline.  ``deploy_*`` events (the rolling updater's
-        lifecycle) keep their own namespace; everything else gets the
-        ``supervisor_`` prefix.  ``replica_idx`` may be an int index or an
-        rid string ("r0"); None means the fleet as a whole."""
+        lifecycle) and ``autoscale_*`` events (elastic scaling decisions)
+        keep their own namespaces; everything else gets the ``supervisor_``
+        prefix.  ``replica_idx`` may be an int index or an rid string
+        ("r0"); None means the fleet as a whole."""
         if replica_idx is None:
             source = "supervisor"
         elif isinstance(replica_idx, int):
             source = f"r{replica_idx}"
         else:
             source = str(replica_idx)
-        kind = event if event.startswith("deploy_") else f"supervisor_{event}"
+        kind = (
+            event
+            if event.startswith(("deploy_", "autoscale_"))
+            else f"supervisor_{event}"
+        )
         self.store.add_event(kind, source, detail=detail)
 
     # -- background loop ----------------------------------------------------

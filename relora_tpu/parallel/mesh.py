@@ -126,6 +126,39 @@ def shard_params(params: PyTree, shardings: PyTree) -> PyTree:
     )
 
 
+def partition_rule_version() -> str:
+    """Stable fingerprint of ``LOGICAL_RULES``.
+
+    Stamped into checkpoint manifests so a restore site can tell whether the
+    checkpoint's arrays were laid out under the same logical->mesh mapping.
+    Chip count and mesh shape may change across an elastic resume (that is
+    the point of train/elastic.py); the rule table may not — resharding
+    re-applies the rules by name, so a renamed or remapped logical axis would
+    silently place arrays wrong.
+    """
+    import hashlib
+
+    return hashlib.sha1(repr(LOGICAL_RULES).encode()).hexdigest()[:12]
+
+
+def mesh_metadata(mesh: Optional[Mesh]) -> dict:
+    """JSON-safe description of the mesh a checkpoint was saved under:
+    axis-name -> size shape, total chip count, and the partition-rule
+    fingerprint.  ``mesh=None`` (single-device training) records chip count 1
+    and an empty shape."""
+    if mesh is None:
+        shape: dict = {}
+        chips = 1
+    else:
+        shape = {name: int(size) for name, size in mesh.shape.items()}
+        chips = int(np.prod(list(mesh.shape.values())))
+    return {
+        "mesh_shape": shape,
+        "chip_count": chips,
+        "partition_rule_version": partition_rule_version(),
+    }
+
+
 # ---------------------------------------------------------------------------
 # current-mesh registry: ops that need an explicit mesh (e.g. the ring
 # attention shard_map) read it here; the Trainer/driver sets it once.

@@ -106,6 +106,22 @@ EndpointSource = Union[
 ]
 
 
+def rendezvous_home(adapter: str, groups: Sequence[str]) -> Optional[str]:
+    """The shard group ``adapter``'s traffic homes to, by rendezvous
+    (highest-random-weight) hashing over ``groups``.
+
+    The property elastic scaling leans on: when a group joins or leaves,
+    only the tenants whose maximal hash involved that group move — every
+    other tenant keeps its home, so a fleet resize never thrashes the
+    whole fleet's adapter slots, just the departed/added replica's share.
+    """
+    if not groups:
+        return None
+    return max(
+        groups, key=lambda g: hashlib.sha1(f"{adapter}:{g}".encode()).digest()
+    )
+
+
 class _ClientGone(Exception):
     """The *downstream* client hung up mid-proxy — not the replica's fault,
     so it must not feed the replica's circuit breaker."""
@@ -471,10 +487,7 @@ class Router:
             # long as that group stays up.  Fall back to least-loaded when
             # the home is excluded (already tried) or its breaker won't
             # admit a request.
-            home = max(
-                routable_groups,
-                key=lambda g: hashlib.sha1(f"{adapter}:{g}".encode()).digest(),
-            )
+            home = rendezvous_home(adapter, routable_groups)
             for st, _load in candidates:
                 if (st.group or st.rid) != home:
                     continue

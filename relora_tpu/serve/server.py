@@ -16,9 +16,10 @@ Endpoints:
   finish record as a single JSON body.
 - ``GET /healthz`` — readiness: 200 while accepting; 503 with ``status``
   ``"draining"`` (SIGTERM), ``"stuck"`` (stall watchdog: no decode step for
-  ``stall_timeout_s``), or ``"error"`` (model thread died) — the router
-  (serve/router.py) ejects a replica on any 503 and re-adopts it when the
-  status clears.  Paged schedulers attach a ``paging`` block (pool
+  ``stall_timeout_s``), ``"error"`` (model thread died), or ``"warming"``
+  (``warmup_fn`` still paying compile buckets: the replica is discoverable
+  but not yet routable) — the router (serve/router.py) ejects a replica on
+  any 503 and (re-)adopts it when the status clears.  Paged schedulers attach a ``paging`` block (pool
   pressure, prefix-cache stats, and — under ``paging.dispatch`` — the
   dispatch-economics counters: dispatches per round, tokens per dispatch,
   and packed-token utilization when ``--packed`` is on).
@@ -208,6 +209,7 @@ class GenerateServer:
         reload_prepare: Optional[Callable[[str], Callable[[], None]]] = None,
         weights_version: int = 0,
         weights_checkpoint: str = "",
+        warmup_fn: Optional[Callable[[], Any]] = None,
     ):
         self.scheduler = scheduler
         self.host = host
@@ -290,6 +292,18 @@ class GenerateServer:
         self._model_busy = False  # model thread writes; watchdog reads
         self._stuck = False  # watchdog writes; healthz reads
         self._watchdog: Optional[threading.Thread] = None
+        # -- router-aware warmup ----------------------------------------------
+        # warmup_fn runs first on the model thread: the listener binds (and
+        # the port file lands) immediately so the supervisor/collector see
+        # the replica, but /healthz answers 503 "warming" until the compile
+        # buckets are paid for — a health-probing router never sends live
+        # traffic into a cold replica's compile stall.  Promotion to "ok" is
+        # the warmup report completing; a warmup failure takes the normal
+        # worker-error path instead.
+        self.warmup_fn = warmup_fn
+        self.warmup_report: Optional[Any] = None
+        self._warming = warmup_fn is not None
+        self.stats.set_gauge("warming", 1 if self._warming else 0)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -359,6 +373,26 @@ class GenerateServer:
         when draining and nothing is left anywhere."""
         sched = self.scheduler
         try:
+            if self.warmup_fn is not None:
+                t0 = time.monotonic()
+                logger.info("warmup: paying compile buckets before going routable")
+                self.warmup_report = self.warmup_fn()
+                self._warming = False
+                self.stats.set_gauge("warming", 0)
+                self._last_step_t = time.monotonic()
+                logger.info(
+                    f"warmup complete in {time.monotonic() - t0:.1f}s; healthz -> ok"
+                )
+                if self.metrics is not None:
+                    detail = (
+                        self.warmup_report
+                        if isinstance(self.warmup_report, dict)
+                        else {}
+                    )
+                    self.metrics.event(
+                        "serve_warm", duration_s=round(time.monotonic() - t0, 3),
+                        **detail,
+                    )
             while True:
                 faults.serve_tick(self._tokens_emitted)  # serving drills only
                 # a pending reload pauses *claiming* only: queued tickets wait
@@ -683,13 +717,16 @@ class GenerateServer:
 
     async def _handle_healthz(self, writer: asyncio.StreamWriter) -> None:
         # precedence: a dead worker trumps everything, a wedged worker trumps
-        # drain state — the router must stop routing on all three
+        # drain state, drain trumps warming — the router must stop routing
+        # (or never start, for "warming") on all four
         if self._worker_error is not None:
             state, status = "error", 503
         elif self._stuck:
             state, status = "stuck", 503
         elif self.admission.draining:
             state, status = "draining", 503
+        elif self._warming:
+            state, status = "warming", 503
         else:
             state, status = "ok", 200
         payload = {
@@ -712,6 +749,8 @@ class GenerateServer:
             payload["detail"] = (
                 f"no decode step completed for {self.stall_timeout_s:.1f}s"
             )
+        elif self._warming:
+            payload["detail"] = "compile warmup in progress"
         # paged scheduler: pool pressure for the allocator-exhaustion triage
         # flow (docs/operations.md) — queued-but-healthy vs queued-and-starved
         paging_stats = getattr(self.scheduler, "paging_stats", None)

@@ -20,6 +20,11 @@ Restart policy:
   graceful drain (``serve_drain_complete``) before touching the next — the
   router keeps serving from the others throughout, so a fleet SIGTERM
   loses zero requests.
+- **Elastic scaling.**  ``scale_up()``/``scale_down()`` add or drain one
+  replica at a time (serve/autoscale.py decides when, ``--autoscale`` arms
+  it).  Scale actions and the rolling drain serialize behind one scale
+  lock; a drain cancels every scale action requested after it began, so a
+  SIGTERM never races a concurrent autoscaler decision.
 
 Port discovery is file-based and restart-safe: each replica gets
 ``--port 0 --port-file <workdir>/replica_<i>.port``; the supervisor deletes
@@ -145,6 +150,12 @@ class ReplicaSupervisor:
             for i in range(n_replicas)
         ]
         self._lock = threading.RLock()
+        # serializes scale actions against each other AND against the rolling
+        # drain: begin_rolling_drain holds it for its whole duration, so a
+        # concurrent autoscaler decision either completes first or is
+        # cancelled — never interleaves with the drain (the SIGTERM race)
+        self._scale_lock = threading.RLock()
+        self._next_idx = n_replicas  # monotonic: freed indices are never reused
         self._stop = threading.Event()
         self._draining = False
         self._monitor: Optional[threading.Thread] = None
@@ -163,10 +174,11 @@ class ReplicaSupervisor:
         """Immediate teardown (test/bench cleanup): SIGKILL everything."""
         self._stop.set()
         with self._lock:
-            for rep in self._replicas:
+            reps = list(self._replicas)
+            for rep in reps:
                 if rep.proc is not None and rep.proc.poll() is None:
                     rep.proc.kill()
-        for rep in self._replicas:
+        for rep in reps:
             if rep.proc is not None:
                 try:
                     rep.proc.wait(timeout=10.0)
@@ -182,30 +194,126 @@ class ReplicaSupervisor:
         """SIGTERM replicas one at a time, each graceful drain completing
         before the next starts — the rest of the fleet keeps serving.
         Blocks until every replica has exited (or drain_timeout_s forces a
-        kill); idempotent-ish: a second call finds nothing left to drain."""
+        kill); idempotent-ish: a second call finds nothing left to drain.
+
+        Holds the scale lock for its whole duration: an in-flight scale
+        action finishes first, and every scale action requested after the
+        drain began is cancelled (``_draining`` is set before the lock is
+        released to a waiting ``scale_up``/``scale_down``)."""
         with self._lock:
             self._draining = True
-        logger.info("rolling drain: one replica at a time")
-        for rep in self._replicas:
+        with self._scale_lock:
+            logger.info("rolling drain: one replica at a time")
             with self._lock:
-                proc = rep.proc
-                if proc is None or proc.poll() is not None:
-                    continue
-                rep.draining = True
-            self._event("drain_begin", rep)
-            proc.send_signal(signal.SIGTERM)
-            try:
-                proc.wait(timeout=self.drain_timeout_s)
-            except subprocess.TimeoutExpired:
-                logger.error(
-                    f"replica {rep.rid}: drain exceeded {self.drain_timeout_s}s; killing"
+                reps = list(self._replicas)
+            for rep in reps:
+                with self._lock:
+                    proc = rep.proc
+                    if proc is None or proc.poll() is not None:
+                        continue
+                    rep.draining = True
+                self._event("drain_begin", rep)
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=self.drain_timeout_s)
+                except subprocess.TimeoutExpired:
+                    logger.error(
+                        f"replica {rep.rid}: drain exceeded {self.drain_timeout_s}s; killing"
+                    )
+                    proc.kill()
+                    proc.wait(timeout=10.0)
+                self._remove_stale(rep)
+                self._event("drain_complete", rep, exit_code=proc.returncode)
+                logger.info(f"replica {rep.rid} drained (exit {proc.returncode})")
+            self._stop.set()
+
+    # -- elastic scaling (the autoscaler's levers) ---------------------------
+
+    def scale_up(self) -> Optional[str]:
+        """Add one replica: spawn a new process with the next (never-reused)
+        index and report it via ``endpoints`` — the router picks it up on
+        its next probe round.  Returns the new rid, or ``None`` when the
+        action was cancelled because the fleet is draining or stopping
+        (a decision made *before* a SIGTERM landed must not spawn a process
+        the drain will never visit)."""
+        with self._scale_lock:
+            with self._lock:
+                if self._draining or self._stop.is_set():
+                    self._event("autoscale_up_cancelled", None, reason="draining")
+                    logger.info("autoscale: scale-up cancelled — fleet is draining")
+                    return None
+                idx = self._next_idx
+                self._next_idx += 1
+                rep = _Replica(
+                    idx=idx,
+                    port_file=os.path.join(self.workdir, f"replica_{idx}.port"),
+                    pid_file=os.path.join(self.workdir, f"replica_{idx}.pid"),
+                    log_path=os.path.join(self.workdir, f"replica_{idx}.log"),
                 )
-                proc.kill()
-                proc.wait(timeout=10.0)
+                self._replicas.append(rep)
+                self._spawn(rep, first=True)
+                pid = rep.proc.pid if rep.proc is not None else None
+            self._event("autoscale_up", rep, pid=pid)
+            logger.info(f"autoscale: added replica {rep.rid} (pid {pid})")
+            return rep.rid
+
+    def scale_down(self, idx: Optional[int] = None) -> Optional[str]:
+        """Drain and remove one replica (default: the newest non-draining
+        one).  Blocks through the graceful drain, then drops the replica
+        from the fleet entirely — ``endpoints``/``status`` stop reporting
+        it.  Refuses (returns ``None``) when the fleet is draining/stopping,
+        when it would leave fewer than one live replica, or when ``idx``
+        names a replica that is gone or already draining."""
+        with self._scale_lock:
+            with self._lock:
+                if self._draining or self._stop.is_set():
+                    return None
+                candidates = [
+                    r for r in self._replicas if not r.draining and not r.quarantined
+                ]
+                if len(candidates) <= 1:
+                    return None
+                if idx is None:
+                    rep = candidates[-1]
+                else:
+                    matches = [r for r in candidates if r.idx == idx]
+                    if not matches:
+                        return None
+                    rep = matches[0]
+                rep.draining = True
+                proc = rep.proc
+            self._event("autoscale_down", rep)
+            exit_code: Optional[int] = None
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=self.drain_timeout_s)
+                except subprocess.TimeoutExpired:
+                    logger.error(
+                        f"replica {rep.rid}: scale-down drain exceeded "
+                        f"{self.drain_timeout_s}s; killing"
+                    )
+                    proc.kill()
+                    proc.wait(timeout=10.0)
+                exit_code = proc.returncode
             self._remove_stale(rep)
-            self._event("drain_complete", rep, exit_code=proc.returncode)
-            logger.info(f"replica {rep.rid} drained (exit {proc.returncode})")
-        self._stop.set()
+            with self._lock:
+                self._replicas = [r for r in self._replicas if r is not rep]
+            if rep.log_fh is not None:
+                rep.log_fh.close()
+                rep.log_fh = None
+            self._event("autoscale_down_complete", rep, exit_code=exit_code)
+            logger.info(f"autoscale: removed replica {rep.rid} (exit {exit_code})")
+            return rep.rid
+
+    def n_live(self) -> int:
+        """Replicas that count toward capacity: not draining, not
+        quarantined (a crash-looping replica in backoff still counts — it
+        is coming back; the autoscaler must not double-provision it)."""
+        with self._lock:
+            return sum(
+                1 for r in self._replicas if not r.draining and not r.quarantined
+            )
 
     # -- the router's view ---------------------------------------------------
 
@@ -214,7 +322,9 @@ class ReplicaSupervisor:
         down, restarting, or quarantined.  The router polls this every probe
         round, so restarts (new ephemeral ports) propagate automatically."""
         out: Dict[str, Tuple[str, Optional[int]]] = {}
-        for rep in self._replicas:
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
             port: Optional[int] = None
             if rep.proc is not None and rep.proc.poll() is None:
                 try:
@@ -241,21 +351,29 @@ class ReplicaSupervisor:
             }
 
     def pid(self, idx: int) -> Optional[int]:
-        rep = self._replicas[idx]
-        return rep.proc.pid if rep.proc is not None else None
+        rep = self._rep_by_idx(idx)
+        return rep.proc.pid if rep is not None and rep.proc is not None else None
 
     def send_signal(self, idx: int, sig: int) -> None:
         """Deliver a signal to one replica (drills: SIGKILL under load)."""
-        rep = self._replicas[idx]
-        if rep.proc is not None and rep.proc.poll() is None:
+        rep = self._rep_by_idx(idx)
+        if rep is not None and rep.proc is not None and rep.proc.poll() is None:
             rep.proc.send_signal(sig)
 
     # -- internals -----------------------------------------------------------
 
-    def _event(self, event: str, rep: _Replica, **detail) -> None:
+    def _rep_by_idx(self, idx: int) -> Optional[_Replica]:
+        # replica index != list position once the fleet has scaled
+        with self._lock:
+            for rep in self._replicas:
+                if rep.idx == idx:
+                    return rep
+        return None
+
+    def _event(self, event: str, rep: Optional[_Replica], **detail) -> None:
         if self.on_event is not None:
             try:
-                self.on_event(event, rep.idx, detail)
+                self.on_event(event, rep.idx if rep is not None else None, detail)
             except Exception:
                 pass
 
@@ -307,7 +425,9 @@ class ReplicaSupervisor:
 
     def _check(self, rep: _Replica) -> None:
         now = time.monotonic()
-        if rep.quarantined:
+        if rep.quarantined or rep.draining:
+            # a draining replica's exit is expected, not a crash; scale_down
+            # owns it until it is removed from the fleet
             return
         if rep.restart_at is not None:
             if now >= rep.restart_at:
@@ -353,9 +473,10 @@ class ReplicaSupervisor:
         """Optional: callers that know a replica is serving again (e.g. the
         CLI watching router health) can clear its crash streak so an
         occasional crash every few hours never accumulates to quarantine."""
-        rep = self._replicas[idx]
-        with self._lock:
-            rep.consecutive_crashes = 0
+        rep = self._rep_by_idx(idx)
+        if rep is not None:
+            with self._lock:
+                rep.consecutive_crashes = 0
 
 
 # -- CLI: supervisor + router in one front-end process -----------------------
@@ -386,6 +507,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="env override for one replica's FIRST incarnation only (drills: "
         "arm a faults.py site on r0; the respawn comes back clean)",
     )
+    p.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="SLO-driven elastic scaling: grow the fleet on sustained TTFT/"
+        "queue/slot burn, drain it back on sustained idle (docs/operations.md "
+        "has the runbook).  Requires the fleet collector (--fleet-cadence-s > 0); "
+        "--replicas is the starting size.",
+    )
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument(
+        "--ttft-p95-target-s", type=float, default=2.0,
+        help="scale-up high-water mark for per-replica TTFT p95",
+    )
+    p.add_argument("--queue-depth-high", type=float, default=4.0)
+    p.add_argument("--slot-util-high", type=float, default=0.9)
+    p.add_argument(
+        "--burn-window-s", type=float, default=5.0,
+        help="pressure must be sustained this long on every replica to add one",
+    )
+    p.add_argument(
+        "--idle-window-s", type=float, default=15.0,
+        help="quiet must be sustained this long on every replica to drain one",
+    )
+    p.add_argument("--cooldown-s", type=float, default=10.0)
+    p.add_argument("--autoscale-interval-s", type=float, default=1.0)
     p.add_argument(
         "--fleet-cadence-s",
         type=float,
@@ -494,6 +641,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if collector is not None:
         collector.start()
 
+    # elastic scaling: the collector's store drives replica count through
+    # the supervisor's scale levers (decisions land as autoscale_* events)
+    autoscaler = None
+    if args.autoscale:
+        if collector is None:
+            raise SystemExit("--autoscale requires the collector (--fleet-cadence-s > 0)")
+        from relora_tpu.serve.autoscale import Autoscaler, AutoscalerPolicy
+
+        policy = AutoscalerPolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            ttft_p95_target_s=args.ttft_p95_target_s,
+            queue_depth_high=args.queue_depth_high,
+            slot_util_high=args.slot_util_high,
+            burn_window_s=args.burn_window_s,
+            idle_window_s=args.idle_window_s,
+            cooldown_s=args.cooldown_s,
+        )
+        autoscaler = Autoscaler(
+            policy, sup, collector.store, interval_s=args.autoscale_interval_s
+        ).start()
+        logger.info(
+            f"autoscaler armed: {args.min_replicas}..{args.max_replicas} replicas, "
+            f"burn window {args.burn_window_s:g}s / idle window {args.idle_window_s:g}s"
+        )
+
     # continuous deployment: watcher verifies each published checkpoint, the
     # rolling updater hot-swaps it across the fleet behind the canary gate
     watcher = None
@@ -562,6 +735,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         asyncio.run(_main())
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if watcher is not None:
             watcher.stop()
         if collector is not None:

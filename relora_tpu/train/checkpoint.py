@@ -77,7 +77,7 @@ def _checkpointer():
 
 # checkpoint dirs whose async write has been initiated but whose manifest
 # (size+crc32 per committed file) cannot be computed until the write lands;
-# entries are (path, array_manifest) finalized at the next fence.
+# entries are (path, array_manifest, metadata) finalized at the next fence.
 _PENDING_MANIFESTS: list = []
 
 
@@ -140,11 +140,12 @@ def _finalize_pending_manifests() -> None:
     pending, _PENDING_MANIFESTS = _PENDING_MANIFESTS, []
     if jax.process_index() != 0:
         return
-    for path, arrays in pending:
+    for path, arrays, metadata in pending:
         if not os.path.isdir(os.path.join(path, STATE_SUBDIR)):
             logger.warning(f"checkpoint {path} never committed; no manifest written")
             continue
-        manifest = {"arrays": arrays, "files": _walk_state_files(path)}
+        manifest = {"arrays": arrays, "files": _walk_state_files(path),
+                    "metadata": metadata}
         tmp = os.path.join(path, MANIFEST_FILE + ".tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=2)
@@ -205,6 +206,7 @@ def save_checkpoint(
     lora_spec: Optional[LoraSpec] = None,
     retries: int = 3,
     retry_backoff: float = 0.5,
+    manifest_metadata: Optional[dict] = None,
 ) -> str:
     """Write one checkpoint dir; returns its path.  Safe to call from every
     process — Orbax coordinates the multi-host write; JSON goes from
@@ -215,7 +217,12 @@ def save_checkpoint(
     are the synchronous touchpoints where a flaky filesystem surfaces.  A
     failure of the *background* write is caught downstream instead: the dir
     never gains a committed ``state/`` (or fails manifest verification) and
-    autoresume skips it."""
+    autoresume skips it.
+
+    ``manifest_metadata`` lands under the manifest's ``metadata`` key.  When
+    not given it is derived from the current mesh (mesh shape, chip count,
+    partition-rule version) so ``train/elastic.py`` can validate a reshard
+    target and ``restore_serving_params`` can reject a rule-mismatched dir."""
     path = checkpoint_dir(save_dir, update_step)
     ckptr = _checkpointer()
     # fence the previous in-flight save (usually a no-op: saves are far
@@ -254,7 +261,11 @@ def save_checkpoint(
                 f"({e}); retrying in {delay:.1f}s"
             )
             time.sleep(delay)
-    _PENDING_MANIFESTS.append((path, _array_manifest(state)))
+    if manifest_metadata is None:
+        from relora_tpu.parallel.mesh import current_mesh, mesh_metadata
+
+        manifest_metadata = mesh_metadata(current_mesh())
+    _PENDING_MANIFESTS.append((path, _array_manifest(state), manifest_metadata))
     logger.info(f"Saving checkpoint to {path} (async)")
     return path
 
@@ -331,10 +342,26 @@ def restore_serving_params(path: str) -> PyTree:
 
     Every call — serve startup and every in-place reload — verifies the
     size+crc32 manifest first, so a torn or bit-flipped checkpoint is
-    rejected (with the failing file named) before any device write."""
+    rejected (with the failing file named) before any device write.  A
+    manifest recorded under a *different partition-rule version* is rejected
+    too (reason ``partition_rule_mismatch``): the serving merge walks the
+    tree by logical-axis names, so a rule-table drift means the arrays may
+    not mean what the walk assumes.  Chip count and mesh shape are allowed
+    to differ — serving restores host-side and replaces the layout anyway."""
     ok, reason = verify_checkpoint(path)
     if not ok:
         raise ValueError(f"refusing to serve corrupt checkpoint {path}: {reason}")
+    meta = load_manifest_metadata(path)
+    if meta is not None and "partition_rule_version" in meta:
+        from relora_tpu.parallel.mesh import partition_rule_version
+
+        want = partition_rule_version()
+        got = meta["partition_rule_version"]
+        if got != want:
+            raise ValueError(
+                f"refusing to serve checkpoint {path}: partition_rule_mismatch "
+                f"(checkpoint rules {got}, runtime rules {want})"
+            )
     params = restore_params_host(path)
     spec = load_lora_spec(path)
     if spec is None:
@@ -342,6 +369,21 @@ def restore_serving_params(path: str) -> PyTree:
     from relora_tpu.core.relora import merged_params
 
     return merged_params(params, spec)
+
+
+def load_manifest_metadata(path: str) -> Optional[dict]:
+    """The manifest's ``metadata`` block (mesh shape, chip count,
+    partition-rule version) for a checkpoint dir.  ``None`` for legacy
+    checkpoints whose manifest predates the key — callers must treat those
+    as unverifiable rather than mismatched."""
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    meta = manifest.get("metadata")
+    return meta if isinstance(meta, dict) else None
 
 
 def load_training_state(path: str) -> dict:

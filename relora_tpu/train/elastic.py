@@ -1,0 +1,159 @@
+"""Elastic resume: reshard a checkpoint onto a different chip count / mesh.
+
+ReLoRA's economics assume cheap, *resizable* capacity: a run that
+checkpoints on an 8-chip mesh must be able to continue on 4 chips after a
+partial preemption and grow back to 8 when capacity returns — without
+losing the optimizer state or bending the loss curve.  Orbax's fast path
+(``checkpoint.restore_checkpoint``) restores shards straight onto the mesh
+the state was saved under; that is exactly what breaks when the topology
+changed.
+
+This module is the slow-but-shape-free path:
+
+1. restore the full TrainState **host-side** via the manifest
+   (``restore_state_host`` — every leaf forced to numpy, no device layout
+   assumed);
+2. re-apply the regex partition rules for the *new* mesh — the Trainer has
+   already done this by building a fresh sharded ``TrainState`` template
+   from ``LOGICAL_RULES``, so the template's per-leaf shardings ARE the
+   rules resolved against the new topology;
+3. re-place every restored array onto its template leaf's sharding
+   (``jax.device_put``).  Optimizer moments, LoRA A/B factors, and the
+   frozen base all ride the same walk — there is one rule table.
+
+Validation comes first: the checkpoint manifest records the mesh shape,
+chip count, and partition-rule fingerprint it was saved under
+(``checkpoint.save_checkpoint`` / ``mesh.mesh_metadata``).  A reshard is
+only attempted when the *rules* match — shapes and chip counts may differ
+(that is the point), but a drifted rule table means the logical-axis names
+no longer describe the arrays and re-placing would be silently wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from relora_tpu.parallel.mesh import mesh_metadata, partition_rule_version
+from relora_tpu.train import checkpoint as ckpt
+from relora_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PyTree = Any
+
+# re-exported for callers that reach elastic-first (tests, tools)
+load_manifest_metadata = ckpt.load_manifest_metadata
+
+
+def needs_reshard(meta: Optional[dict], mesh) -> bool:
+    """Does restoring under ``mesh`` require the host-side reshard path?
+
+    ``meta`` is the checkpoint's manifest metadata (``None`` for legacy
+    checkpoints — those take the fast path; they carry no topology claim to
+    contradict).  True when the recorded mesh shape or chip count differs
+    from the current mesh."""
+    if meta is None:
+        return False
+    here = mesh_metadata(mesh)
+    if meta.get("chip_count") != here["chip_count"]:
+        return True
+    recorded = meta.get("mesh_shape")
+    return recorded is not None and recorded != here["mesh_shape"]
+
+
+def validate_reshard(meta: Optional[dict], mesh) -> Tuple[bool, str]:
+    """Can a checkpoint saved under ``meta`` be resharded onto ``mesh``?
+
+    Returns ``(ok, reason)`` with a *named* reason — callers surface it
+    verbatim.  Rules:
+
+    - ``missing_metadata``: no manifest metadata — the checkpoint predates
+      topology stamping, so a reshard target cannot be validated.
+    - ``partition_rule_mismatch``: the checkpoint was laid out under a
+      different ``LOGICAL_RULES`` fingerprint; re-applying today's rules to
+      its arrays would place them wrong.
+    - ``ok``: rules match; any chip count / mesh shape is fair game.
+    """
+    if meta is None:
+        return False, "missing_metadata"
+    want = partition_rule_version()
+    got = meta.get("partition_rule_version")
+    if got != want:
+        return False, (
+            f"partition_rule_mismatch (checkpoint rules {got}, runtime rules {want})"
+        )
+    return True, "ok"
+
+
+def _normalized_paths(tree: PyTree):
+    """``[(path_tuple, leaf)]`` with every keypath entry collapsed to a
+    string, so a dataclass field, a dict key, a namedtuple field, and a
+    tuple index all compare under the one naming scheme Orbax uses on disk
+    (field/dict names verbatim, sequence positions as ``"0"``, ``"1"``…)."""
+    out = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        parts = []
+        for entry in keypath:
+            if hasattr(entry, "key"):  # DictKey / FlattenedIndexKey
+                parts.append(str(entry.key))
+            elif hasattr(entry, "name"):  # GetAttrKey (dataclass, namedtuple)
+                parts.append(str(entry.name))
+            elif hasattr(entry, "idx"):  # SequenceKey
+                parts.append(str(entry.idx))
+            else:
+                parts.append(str(entry))
+        out.append((tuple(parts), leaf))
+    return out
+
+
+def reshard_tree(host_tree: PyTree, template: PyTree) -> PyTree:
+    """Place a host-restored tree onto ``template``'s shardings.
+
+    ``host_tree`` is whatever ``restore_state_host`` returned (nested
+    containers of numpy arrays, structure-as-serialized); ``template`` is a
+    live sharded tree (e.g. the Trainer's freshly built ``TrainState``).
+    Leaves are matched by normalized key path — positional zip would
+    misalign a dict-restored ``TrainState`` whose dict ordering differs
+    from the dataclass field order.  Returns the *template's* structure
+    with every leaf replaced by the restored value, device_put onto the
+    template leaf's sharding."""
+    host = dict(_normalized_paths(host_tree))
+    t_paths = _normalized_paths(template)
+    missing = [p for p, _ in t_paths if p not in host]
+    if missing:
+        raise ValueError(
+            f"checkpoint is missing {len(missing)} arrays the current state "
+            f"needs; first: {'/'.join(missing[0])}"
+        )
+    leaves = []
+    for path, t_leaf in t_paths:
+        value = np.asarray(host[path])
+        t_shape = tuple(getattr(t_leaf, "shape", ()) or ())
+        if value.shape != t_shape:
+            raise ValueError(
+                f"shape mismatch at {'/'.join(path)}: checkpoint "
+                f"{value.shape} vs current state {t_shape} — elastic resume "
+                f"reshapes the mesh, never the arrays"
+            )
+        dtype = getattr(t_leaf, "dtype", None)
+        if dtype is not None and value.dtype != dtype:
+            value = value.astype(dtype)
+        sharding = getattr(t_leaf, "sharding", None)
+        leaves.append(jax.device_put(value, sharding) if sharding is not None else value)
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def restore_resharded(path: str, template_state: PyTree) -> PyTree:
+    """Restore the checkpoint at ``path`` onto ``template_state``'s mesh.
+
+    The elastic slow path: host-side manifest restore, then per-leaf
+    re-placement onto the template's shardings.  The caller is expected to
+    have validated the target first (``validate_reshard``)."""
+    host = ckpt.restore_state_host(path)
+    state = reshard_tree(host, template_state)
+    logger.info(f"Elastically resharded checkpoint {path} onto the current mesh")
+    return state

@@ -55,6 +55,7 @@ from relora_tpu.parallel.mesh import (
     batch_sharding,
     eval_batch_sharding,
     make_mesh,
+    mesh_metadata,
     param_shardings,
 )
 from relora_tpu.train import checkpoint as ckpt
@@ -348,14 +349,12 @@ class Trainer:
         self.state = self._normalize_placement(self.state)
 
         if self.resume_dir and cfg.load_optimizer_state_on_resume:
-            self.state = self._normalize_placement(
-                ckpt.restore_checkpoint(self.resume_dir, self.state)
-            )
+            self.state = self._normalize_placement(self._restore_state(self.resume_dir))
             logger.info(f"Restored full train state from {self.resume_dir}")
         elif self.resume_dir:
             from relora_tpu.core.optim import set_schedule_count
 
-            restored = ckpt.restore_checkpoint(self.resume_dir, self.state)
+            restored = self._restore_state(self.resume_dir)
             self.state = self.state.replace(
                 params=restored.params,
                 # fresh optimizer, but the LR schedule continues from the
@@ -497,6 +496,30 @@ class Trainer:
             cfg.save(os.path.join(cfg.save_dir, "training_config.yaml"))
 
     # ------------------------------------------------------------------
+    def _restore_state(self, path: str) -> PyTree:
+        """Restore a full TrainState from ``path`` onto this mesh.
+
+        Same-topology checkpoints take Orbax's fast path (shards restored
+        straight onto the recorded layout).  A checkpoint whose manifest
+        records a *different* mesh shape or chip count — a preempted-and-
+        resized run — goes through the elastic reshard: host-side restore,
+        then re-placement under this mesh's partition rules, optimizer
+        state included (train/elastic.py)."""
+        from relora_tpu.train import elastic
+
+        meta = ckpt.load_manifest_metadata(path)
+        if elastic.needs_reshard(meta, self.mesh):
+            ok, reason = elastic.validate_reshard(meta, self.mesh)
+            if not ok:
+                raise RuntimeError(f"cannot elastically resume from {path}: {reason}")
+            logger.info(
+                f"Elastic resume: checkpoint saved on {meta.get('chip_count')} "
+                f"chip(s) {meta.get('mesh_shape')}, resharding onto "
+                f"{dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}"
+            )
+            return elastic.restore_resharded(path, self.state)
+        return ckpt.restore_checkpoint(path, self.state)
+
     def _normalize_placement(self, tree: PyTree) -> PyTree:
         """Ensure every leaf lives on this mesh's device set: leaves already
         sharded over the full mesh are kept; stragglers (jit-placed or
@@ -1220,7 +1243,7 @@ class Trainer:
             range(spike.first_step - 1, spike.last_step + cfg.spike_rollback_margin)
         )
         cfg.skip_batches |= new_skips
-        self.state = self._normalize_placement(ckpt.restore_checkpoint(target, self.state))
+        self.state = self._normalize_placement(self._restore_state(target))
         self.update_step = ts["update_step"]
         self.global_step = ts["global_step"]
         self.tokens_seen = ts["tokens_seen"]
@@ -1275,6 +1298,7 @@ class Trainer:
                     self.lora_spec,
                     retries=self.cfg.save_retries,
                     retry_backoff=self.cfg.save_retry_backoff,
+                    manifest_metadata=mesh_metadata(self.mesh),
                 )
         except (OSError, ValueError) as e:
             # a lost periodic checkpoint must not kill a long run: the

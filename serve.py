@@ -445,37 +445,51 @@ def main(argv=None) -> int:
             if args.run_dir
             else None
         )
+        def preload_adapters():
+            # preload AFTER warmup: the warmup pass writes a zero adapter
+            # into the last slot to compile the slot-write program, which
+            # would clobber a preloaded tenant if it ran second
+            if adapter_registry is not None and args.adapters:
+                for name in [n.strip() for n in args.adapters.split(",") if n.strip()]:
+                    try:
+                        slot = adapter_registry.acquire(name)
+                    except ValueError as e:
+                        raise SystemExit(f"--adapters: {e}")
+                    adapter_registry.release(name)
+                    logger.info(f"preloaded adapter {name!r} into slot {slot}")
+
+        # router-aware warmup: the compile pass runs on the server's model
+        # thread, so the listener binds (and the port file lands) first and
+        # /healthz answers 503 "warming" until the buckets are paid — a
+        # cold replica joining a fleet is discoverable but never routable
+        # mid-compile.  --no-warmup keeps the old shape: no warming window,
+        # first request pays the compiles.
+        warmup_fn = None
         if not args.no_warmup:
-            logger.info("warming serving compiles (disable with --no-warmup)")
-            report = engine.warmup(args.max_batch, packed=args.packed)
-            timings = ", ".join(
-                f"{c['fn']} {c['duration_s']:.2f}s" for c in report["compiles"]
-            )
-            buckets = report.get("packed_buckets") or report["prompt_buckets"]
-            logger.info(
-                f"warmup compiled {report['n_compiles']} programs "
-                f"({'packed' if args.packed else 'prompt'} buckets {buckets}, "
-                f"decode batch {report['batch']}): {timings}"
-            )
-            if metrics is not None:
-                metrics.event(
-                    "warmup",
-                    batch=report["batch"],
-                    prompt_buckets=report["prompt_buckets"],
-                    packed_buckets=report.get("packed_buckets", []),
-                    n_compiles=report["n_compiles"],
+            def warmup_fn():
+                logger.info("warming serving compiles (disable with --no-warmup)")
+                report = engine.warmup(args.max_batch, packed=args.packed)
+                timings = ", ".join(
+                    f"{c['fn']} {c['duration_s']:.2f}s" for c in report["compiles"]
                 )
-        # preload AFTER warmup: the warmup pass writes a zero adapter into
-        # the last slot to compile the slot-write program, which would
-        # clobber a preloaded tenant if it ran second
-        if adapter_registry is not None and args.adapters:
-            for name in [n.strip() for n in args.adapters.split(",") if n.strip()]:
-                try:
-                    slot = adapter_registry.acquire(name)
-                except ValueError as e:
-                    raise SystemExit(f"--adapters: {e}")
-                adapter_registry.release(name)
-                logger.info(f"preloaded adapter {name!r} into slot {slot}")
+                buckets = report.get("packed_buckets") or report["prompt_buckets"]
+                logger.info(
+                    f"warmup compiled {report['n_compiles']} programs "
+                    f"({'packed' if args.packed else 'prompt'} buckets {buckets}, "
+                    f"decode batch {report['batch']}): {timings}"
+                )
+                if metrics is not None:
+                    metrics.event(
+                        "warmup",
+                        batch=report["batch"],
+                        prompt_buckets=report["prompt_buckets"],
+                        packed_buckets=report.get("packed_buckets", []),
+                        n_compiles=report["n_compiles"],
+                    )
+                preload_adapters()
+                return {"batch": report["batch"], "n_compiles": report["n_compiles"]}
+        else:
+            preload_adapters()
         scheduler = build_scheduler(metrics)
 
         from relora_tpu.serve.deploy import CheckpointWatcher, checkpoint_step
@@ -558,6 +572,7 @@ def main(argv=None) -> int:
             stall_timeout_s=args.stall_timeout_s,
             metrics=metrics,
             ready_cb=ready,
+            warmup_fn=warmup_fn,
             reload_prepare=reload_prepare,
             weights_version=(
                 checkpoint_step(args.checkpoint) if args.checkpoint else None

@@ -1,0 +1,419 @@
+"""Elastic resharded training resume: checkpoints survive chip-count changes.
+
+Covers the PR's training acceptance criteria on the 8 virtual CPU devices:
+
+- the checkpoint manifest records the topology it was saved under (mesh
+  shape, chip count, partition-rule fingerprint) and ``validate_reshard``
+  turns those into named accept/reject reasons;
+- ``reshard_tree`` matches host-restored leaves to the template by
+  normalized key path (a dict-restored TrainState must not be zipped
+  positionally against dataclass field order) and refuses shape drift;
+- ``restore_serving_params`` rejects a rule-mismatched checkpoint with the
+  named ``partition_rule_mismatch`` reason while accepting topology-only
+  differences and legacy (metadata-free) checkpoints;
+- the drill: a run checkpointed on an 8-device mesh resumes on 4 devices,
+  then grows back to 8, with the optimizer state resharded along and the
+  loss curve matching an uninterrupted run (a pod resize never loses a
+  run).
+"""
+
+import itertools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.config.training import TrainingConfig
+from relora_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    mesh_metadata,
+    partition_rule_version,
+)
+from relora_tpu.train import checkpoint as ckpt
+from relora_tpu.train import elastic
+from relora_tpu.train.state import TrainState
+
+pytestmark = pytest.mark.elastic
+
+
+# -- topology metadata --------------------------------------------------------
+
+
+def test_mesh_metadata_records_topology(devices):
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2), devices=jax.devices()[:4])
+    meta = mesh_metadata(mesh)
+    assert meta["chip_count"] == 4
+    assert meta["mesh_shape"] == {"data": 2, "fsdp": 2, "tensor": 1, "sequence": 1}
+    # the rule fingerprint is stable within a process and hex-shaped
+    assert meta["partition_rule_version"] == partition_rule_version()
+    assert len(meta["partition_rule_version"]) == 12
+
+
+def test_saved_manifest_carries_metadata(tmp_path, devices):
+    from relora_tpu.parallel.mesh import set_current_mesh
+
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state = _make_state(mesh)
+    # save_checkpoint defaults its metadata from the registered mesh — the
+    # same wiring the Trainer uses
+    set_current_mesh(mesh)
+    path = ckpt.save_checkpoint(str(tmp_path), 3, state, {"update_step": 3})
+    ckpt.wait_for_save()
+    with open(os.path.join(path, ckpt.MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    assert manifest["metadata"]["chip_count"] == 8
+    assert manifest["metadata"]["partition_rule_version"] == partition_rule_version()
+    assert ckpt.load_manifest_metadata(path) == manifest["metadata"]
+
+
+def test_needs_reshard_and_validate(devices):
+    mesh8 = make_mesh(MeshSpec(data=1, fsdp=8))
+    mesh4 = make_mesh(MeshSpec(data=1, fsdp=4), devices=jax.devices()[:4])
+    meta8 = mesh_metadata(mesh8)
+
+    assert not elastic.needs_reshard(meta8, mesh8)  # same topology: fast path
+    assert elastic.needs_reshard(meta8, mesh4)  # chip count changed
+    # same chip count, different factoring is still a reshard
+    mesh8b = make_mesh(MeshSpec(data=2, fsdp=4))
+    assert elastic.needs_reshard(meta8, mesh8b)
+    # legacy checkpoint: no topology claim, no reshard
+    assert not elastic.needs_reshard(None, mesh4)
+
+    ok, reason = elastic.validate_reshard(meta8, mesh4)
+    assert ok and reason == "ok"
+    ok, reason = elastic.validate_reshard(None, mesh4)
+    assert not ok and reason == "missing_metadata"
+    drifted = dict(meta8, partition_rule_version="deadbeef0000")
+    ok, reason = elastic.validate_reshard(drifted, mesh4)
+    assert not ok and reason.startswith("partition_rule_mismatch")
+    assert "deadbeef0000" in reason  # the mismatched fingerprints are named
+
+
+# -- reshard_tree -------------------------------------------------------------
+
+
+def _make_state(mesh):
+    sharding = NamedSharding(mesh, P("fsdp", None))
+    params = {
+        "layer": {
+            "kernel": jax.device_put(
+                jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8), sharding
+            ),
+            "bias": jnp.ones((8,), jnp.float32),
+        }
+    }
+    opt_state = {"mu": jax.tree_util.tree_map(jnp.zeros_like, params)}
+    return TrainState.create(params, opt_state)
+
+
+def test_reshard_tree_matches_by_path_not_position(devices):
+    """A host tree whose container ordering differs from the template's
+    flatten order must still land every leaf on the right template slot."""
+    mesh = make_mesh(MeshSpec(data=1, fsdp=4), devices=jax.devices()[:4])
+    template = _make_state(mesh)
+    # dict restore: alphabetical flatten order (bias before kernel, dict
+    # keys before dataclass fields) and plain numpy leaves
+    host = {
+        "step": np.asarray(7, np.int32),
+        "params": {
+            "layer": {
+                "bias": np.full((8,), 2.0, np.float32),
+                "kernel": np.arange(64.0, dtype=np.float32).reshape(8, 8) * 3.0,
+            }
+        },
+        "opt_state": {
+            "mu": {
+                "layer": {
+                    "bias": np.full((8,), 5.0, np.float32),
+                    "kernel": np.full((8, 8), 4.0, np.float32),
+                }
+            }
+        },
+        "n_skipped": np.asarray(1, np.int32),
+    }
+    out = elastic.reshard_tree(host, template)
+    assert isinstance(out, TrainState)
+    assert int(out.step) == 7 and int(out.n_skipped) == 1
+    np.testing.assert_array_equal(
+        np.asarray(out.params["layer"]["kernel"]),
+        host["params"]["layer"]["kernel"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.opt_state["mu"]["layer"]["bias"]), 5.0 * np.ones(8)
+    )
+    # re-placement: the restored kernel carries the template's sharding
+    assert out.params["layer"]["kernel"].sharding == template.params["layer"]["kernel"].sharding
+
+
+def test_reshard_tree_rejects_missing_and_reshaped_arrays(devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=4), devices=jax.devices()[:4])
+    template = _make_state(mesh)
+    host = jax.tree_util.tree_map(np.asarray, jax.device_get(template))
+    host.params["layer"].pop("bias")
+    with pytest.raises(ValueError, match="missing"):
+        elastic.reshard_tree(host, template)
+
+    host2 = jax.tree_util.tree_map(np.asarray, jax.device_get(template))
+    host2.params["layer"]["bias"] = np.ones((4,), np.float32)
+    with pytest.raises(ValueError, match="never the arrays"):
+        elastic.reshard_tree(host2, template)
+
+
+def test_restore_resharded_roundtrip_across_meshes(tmp_path, devices):
+    """Save fsdp=8, restore via the elastic path onto fsdp=4, then back to
+    fsdp=8: values identical, shardings follow the target mesh."""
+    mesh8 = make_mesh(MeshSpec(data=1, fsdp=8))
+    state = _make_state(mesh8)
+    path = ckpt.save_checkpoint(str(tmp_path), 5, state, {"update_step": 5})
+    ckpt.wait_for_save()
+
+    mesh4 = make_mesh(MeshSpec(data=1, fsdp=4), devices=jax.devices()[:4])
+    template4 = _make_state(mesh4)
+    on4 = elastic.restore_resharded(path, template4)
+    np.testing.assert_array_equal(
+        np.asarray(on4.params["layer"]["kernel"]),
+        np.asarray(state.params["layer"]["kernel"]),
+    )
+    assert on4.params["layer"]["kernel"].sharding.mesh == mesh4
+
+    path4 = ckpt.save_checkpoint(
+        str(tmp_path), 6, on4, {"update_step": 6},
+        manifest_metadata=mesh_metadata(mesh4),
+    )
+    ckpt.wait_for_save()
+    assert ckpt.load_manifest_metadata(path4)["chip_count"] == 4
+    on8 = elastic.restore_resharded(path4, _make_state(mesh8))
+    np.testing.assert_array_equal(
+        np.asarray(on8.params["layer"]["kernel"]),
+        np.asarray(state.params["layer"]["kernel"]),
+    )
+    assert on8.params["layer"]["kernel"].sharding.mesh == mesh8
+
+
+# -- serving-side rejection (satellite: named refusal reasons) ----------------
+
+
+def test_restore_serving_params_rejects_rule_mismatch(tmp_path, devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    state = _make_state(mesh)
+    good = ckpt.save_checkpoint(str(tmp_path / "good"), 1, state, {"update_step": 1})
+    bad_meta = dict(mesh_metadata(mesh), partition_rule_version="deadbeef0000")
+    bad = ckpt.save_checkpoint(
+        str(tmp_path / "bad"), 1, state, {"update_step": 1},
+        manifest_metadata=bad_meta,
+    )
+    ckpt.wait_for_save()
+
+    # topology differences never reject serving (host restore re-lays-out);
+    # a drifted rule table always does, with the named reason
+    params = ckpt.restore_serving_params(good)
+    np.testing.assert_array_equal(
+        np.asarray(params["layer"]["bias"]), np.ones(8, np.float32)
+    )
+    with pytest.raises(ValueError, match="partition_rule_mismatch"):
+        ckpt.restore_serving_params(bad)
+
+    # legacy manifest (no metadata block): accepted
+    manifest_path = os.path.join(good, ckpt.MANIFEST_FILE)
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest.pop("metadata")
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    ckpt.restore_serving_params(good)
+
+
+# -- the drill: 8 -> 4 -> 8 resume with loss parity ---------------------------
+
+TINY = ModelConfig(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=2,
+    max_sequence_length=32,
+)
+
+
+class FakeTokens:
+    """Deterministic synthetic token stream (same shape as test_end_to_end)."""
+
+    def __init__(self, n=512, seq=16, vocab=128, seed=0):
+        rs = np.random.RandomState(seed)
+        rows = []
+        for _ in range(n):
+            start = rs.randint(vocab)
+            rows.append([(start + j) % vocab for j in range(seq)])
+        self.arr = np.asarray(rows, dtype=np.int32)
+
+    def __len__(self):
+        return len(self.arr)
+
+    def __getitem__(self, idx):
+        return {"input_ids": self.arr[idx]}
+
+
+def _elastic_cfg(save_dir, **kw):
+    base = dict(
+        dataset_path="/synthetic",
+        batch_size=1,
+        total_batch_size=8,
+        max_length=16,
+        lr=5e-3,
+        scheduler="cosine_restarts",
+        warmup_steps=2,
+        restart_warmup_steps=2,
+        num_training_steps=12,
+        cycle_length=12,
+        relora=12,
+        use_peft=True,
+        lora_r=4,
+        save_dir=str(save_dir),
+        save_every=4,
+        eval_every=100,
+        seed=0,
+    )
+    base.update(kw)
+    return TrainingConfig(**base).finalize()
+
+
+def _iterators(cfg, trainer, data):
+    from relora_tpu.data.hf_pipeline import TokenBatchIterator
+
+    def train_factory():
+        return iter(
+            TokenBatchIterator(
+                data,
+                microbatch=cfg.batch_size * trainer.n_batch_shards,
+                grad_accum=trainer.grad_accum,
+                skip_updates=trainer.update_step,
+            )
+        )
+
+    def eval_factory():
+        return iter(
+            TokenBatchIterator(
+                data,
+                microbatch=cfg.batch_size * trainer.n_batch_shards,
+                grad_accum=None,
+            )
+        )
+
+    return train_factory, eval_factory
+
+
+def _mesh8():
+    return make_mesh(MeshSpec(data=2, fsdp=4))
+
+
+def _mesh4():
+    return make_mesh(MeshSpec(data=2, fsdp=2), devices=jax.devices()[:4])
+
+
+def _update_losses(save_dir):
+    losses = {}
+    with open(os.path.join(save_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "loss" in rec and "update_step" in rec:
+                losses[rec["update_step"]] = rec["loss"]
+    return losses
+
+
+@pytest.mark.parallel
+def test_elastic_resume_8_4_8_loss_parity(tmp_path):
+    """Checkpoint on an 8-device mesh, resume on 4, grow back to 8: the
+    optimizer state rides the reshard, every segment continues at the right
+    step, and the loss curve matches an uninterrupted 8-device run — a pod
+    resize never loses a run."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=1024)
+
+    # uninterrupted baseline: 12 updates on the full 8-device mesh
+    cfg_a = _elastic_cfg(tmp_path / "a")
+    tr_a = Trainer(cfg_a, model_cfg=TINY, mesh=_mesh8())
+    fa, ea = _iterators(cfg_a, tr_a, data)
+    res_a = tr_a.fit(fa(), ea)
+    assert res_a["update_step"] == 12
+
+    # segment 1: 4 updates on 8 devices, checkpoint at step 4 (save_every)
+    cfg_b = _elastic_cfg(tmp_path / "b")
+    tr_b1 = Trainer(cfg_b, model_cfg=TINY, mesh=_mesh8())
+    fb1, _ = _iterators(cfg_b, tr_b1, data)
+    tr_b1.fit(itertools.islice(fb1(), 4), None)
+    meta = ckpt.load_manifest_metadata(
+        ckpt.checkpoint_dir(cfg_b.save_dir, 4)
+    )
+    assert meta["chip_count"] == 8
+
+    # segment 2: the pod shrank — autoresume on 4 devices must reshard
+    cfg_b2 = _elastic_cfg(tmp_path / "b", autoresume=True)
+    tr_b2 = Trainer(cfg_b2, model_cfg=TINY, mesh=_mesh4())
+    assert tr_b2.update_step == 4  # picked up the 8-device checkpoint
+    kernel = jax.tree_util.tree_leaves(tr_b2.state.params)[0]
+    assert len(kernel.sharding.mesh.devices.flatten()) == 4
+    # the optimizer state came along (4 real updates: moments are non-zero)
+    mu_leaves = [
+        np.asarray(x)
+        for x in jax.tree_util.tree_leaves(tr_b2.state.opt_state)
+        if np.asarray(x).ndim > 0
+    ]
+    assert any(np.abs(leaf).max() > 0 for leaf in mu_leaves)
+    fb2, _ = _iterators(cfg_b2, tr_b2, data)
+    tr_b2.fit(itertools.islice(fb2(), 4), None)
+
+    # segment 3: capacity came back — grow onto 8 devices and finish
+    cfg_b3 = _elastic_cfg(tmp_path / "b", autoresume=True)
+    tr_b3 = Trainer(cfg_b3, model_cfg=TINY, mesh=_mesh8())
+    assert tr_b3.update_step == 8  # picked up the 4-device checkpoint
+    kernel = jax.tree_util.tree_leaves(tr_b3.state.params)[0]
+    assert len(kernel.sharding.mesh.devices.flatten()) == 8
+    fb3, eb3 = _iterators(cfg_b3, tr_b3, data)
+    res_b = tr_b3.fit(fb3(), eb3)
+    assert res_b["update_step"] == 12
+
+    # loss parity: same data order, same total batch per update — only the
+    # reduction layout changed, so the curves must agree to float noise
+    assert res_b["final_eval_loss"] == pytest.approx(
+        res_a["final_eval_loss"], rel=0.02
+    )
+    losses_a = _update_losses(cfg_a.save_dir)
+    losses_b = _update_losses(cfg_b.save_dir)
+    shared = sorted(set(losses_a) & set(losses_b))
+    assert len(shared) >= 6  # the curve is actually being compared
+    for step in shared:
+        assert losses_b[step] == pytest.approx(losses_a[step], rel=0.05), (
+            f"loss diverged at update {step}: "
+            f"{losses_b[step]} vs baseline {losses_a[step]}"
+        )
+
+
+@pytest.mark.parallel
+def test_elastic_resume_refuses_rule_drift(tmp_path, monkeypatch):
+    """A checkpoint stamped with a foreign partition-rule fingerprint must
+    be refused with the named reason, not silently resharded."""
+    from relora_tpu.train.trainer import Trainer
+
+    data = FakeTokens(n=256)
+    cfg = _elastic_cfg(tmp_path / "run")
+    tr = Trainer(cfg, model_cfg=TINY, mesh=_mesh8())
+    f, _ = _iterators(cfg, tr, data)
+    tr.fit(itertools.islice(f(), 4), None)
+    path = ckpt.checkpoint_dir(cfg.save_dir, 4)
+    manifest_path = os.path.join(path, ckpt.MANIFEST_FILE)
+    with open(manifest_path) as fh:
+        manifest = json.load(fh)
+    manifest["metadata"]["partition_rule_version"] = "deadbeef0000"
+    with open(manifest_path, "w") as fh:
+        json.dump(manifest, fh)
+
+    cfg2 = _elastic_cfg(tmp_path / "run", autoresume=True)
+    with pytest.raises(RuntimeError, match="partition_rule_mismatch"):
+        Trainer(cfg2, model_cfg=TINY, mesh=_mesh4())

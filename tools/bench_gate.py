@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Nine rules, each skipped gracefully when its input files are absent:
+Ten rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -40,7 +40,13 @@ Nine rules, each skipped gracefully when its input files are absent:
    ``--tolerance`` of the sequential headline — packing decode and prefill
    into one forward must not starve first tokens.  The latency half is
    skipped off-TPU.
-9. **grouped LoRA** (``BENCH_lora.json`` ``detail.grouped_buckets``): on TPU
+9. **autoscale** (``BENCH_http.json`` ``detail.autoscale_run``): across the
+   1→2→1 elastic resize driven by ``bench.py --mode autoscale``, zero
+   requests may be dropped (rejected-with-429 is typed backpressure and
+   allowed; vanishing mid-stream is not), the burst must have scaled the
+   fleet up, and the quiet tail must have scaled it back down.  Structural
+   — counts requests and replicas, not time — so it runs everywhere.
+10. **grouped LoRA** (``BENCH_lora.json`` ``detail.grouped_buckets``): on TPU
    the grouped multi-tenant arm on a degenerate single-adapter batch
    (``distinct_adapters == 1``) must stay within ``--tolerance`` of the
    single-adapter fused arm on the same (B, K, N, r) bucket — the grouped
@@ -354,6 +360,44 @@ def check_packed(bench_dir: str, tolerance: float) -> List[str]:
     return failures
 
 
+def check_autoscale(bench_dir: str) -> List[str]:
+    """Elastic-fleet rules over ``detail.autoscale_run`` in BENCH_http.json
+    (present only for ``bench.py --mode autoscale`` runs):
+
+    - ``dropped_requests`` must be 0 — a scale-up spawn, a warming replica,
+      or a scale-down drain must never lose an accepted request (429
+      rejections are typed backpressure and do not count);
+    - the burst phase must have scaled the fleet up (``scaled_up``), and the
+      quiet tail must have brought it back to the floor (``scaled_down``) —
+      an autoscaler that never moves is not measuring anything.
+
+    Structural (counts, not wall time), so it runs off-TPU too.
+    """
+    doc = _load(os.path.join(bench_dir, "BENCH_http.json"))
+    run = ((doc or {}).get("detail") or {}).get("autoscale_run")
+    if not run:
+        return []
+    failures = []
+    dropped = run.get("dropped_requests", 0)
+    if dropped:
+        failures.append(
+            f"autoscale: {dropped} dropped request(s) across the 1->2->1 "
+            "resize — every accepted request must terminate (finish record "
+            "or typed error), through spawn, warmup, and drain alike"
+        )
+    if run.get("scaled_up") is False:
+        failures.append(
+            "autoscale: the burst phase never scaled the fleet up "
+            f"(max_replicas_seen={run.get('max_replicas_seen')})"
+        )
+    if run.get("scaled_down") is False:
+        failures.append(
+            "autoscale: the quiet tail never scaled the fleet back down "
+            f"(final_replicas={run.get('final_replicas')})"
+        )
+    return failures
+
+
 def check_grouped_lora(bench_dir: str, tolerance: float) -> List[str]:
     """Grouped multi-tenant LoRA rule over ``detail.grouped_buckets`` in
     BENCH_lora.json: with every row on one adapter (G=1), the grouped
@@ -436,6 +480,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_attn(args.dir, args.tolerance)
         + check_spec(args.dir, baselines, args.tolerance)
         + check_packed(args.dir, args.tolerance)
+        + check_autoscale(args.dir)
         + check_grouped_lora(args.dir, args.tolerance)
     )
 

@@ -15,8 +15,11 @@ Sections:
    comparison window (spot the slow, erroring, or under-packed replica);
 3. SLO / error budget — burn status per objective from a fresh SLOEngine
    pass over the rebuilt store (``--slo-config`` mirrors the collector's);
-4. timeline — health flips, supervisor lifecycle events, SLO burn alerts
-   and anomalies, merged and time-ordered.
+4. autoscale — live replica count (current and min/max over the window)
+   plus every autoscaler decision: scale-ups with the burn signals that
+   drove them, scale-downs, and holds (cooldown, warming, partial burn);
+5. timeline — health flips, supervisor lifecycle, autoscale actions, SLO
+   burn alerts and anomalies, merged and time-ordered.
 
     python tools/fleet_report.py /tmp/fleet/fleet_series.jsonl
     python tools/fleet_report.py fleet.jsonl --join train=ckpts/run/metrics.jsonl
@@ -151,11 +154,50 @@ def slo_status(
             )
 
 
+def autoscale_section(
+    store: SeriesStore, now: float, window_s: float, out=sys.stdout
+) -> None:
+    """Replica count plus the autoscaler's decision record.  Quiet (prints
+    nothing) on fleets that never ran an autoscaler — the section only
+    exists when there is an ``autoscaler`` source or ``autoscale_*`` events
+    to show."""
+    live = store.latest("autoscaler", "replicas_live")
+    counts = store.window_values("autoscaler", "replicas_live", window_s, now=now)
+    decisions = [
+        e for e in store.events() if str(e.get("_event", "")).startswith("autoscale_")
+    ]
+    if live is None and not decisions:
+        return
+    out.write("\n== autoscale ==\n")
+    if live is not None:
+        lo = min(counts) if counts else live[1]
+        hi = max(counts) if counts else live[1]
+        out.write(
+            f"replicas: {live[1]:.0f} live (age {now - live[0]:.1f}s; "
+            f"window min {lo:.0f} / max {hi:.0f})\n"
+        )
+    ups = sum(1 for e in decisions if e.get("action") == "up")
+    downs = sum(1 for e in decisions if e.get("action") == "down")
+    out.write(f"decisions: {len(decisions)} recorded ({ups} up, {downs} down)\n")
+    for e in decisions:
+        detail = {
+            k: v
+            for k, v in e.items()
+            if k not in ("_event", "_source", "_time", "action", "reason")
+        }
+        out.write(
+            f"  {e.get('_time', 0):.2f} {str(e.get('_event')):<24} "
+            f"{str(e.get('action', '-')):<5} {str(e.get('reason', '-')):<28}"
+            + " ".join(f"{k}={v}" for k, v in sorted(detail.items()))
+            + "\n"
+        )
+
+
 def timeline(store: SeriesStore, last: int, out=sys.stdout) -> None:
     events = [
         e
         for e in store.events()
-        if e.get("_event", "").startswith(("supervisor_", "deploy_"))
+        if e.get("_event", "").startswith(("supervisor_", "deploy_", "autoscale_"))
         or e.get("_event") in _TIMELINE_KINDS
     ]
     events.sort(key=lambda e: e.get("_time", 0.0))
@@ -312,6 +354,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     fleet_health(store, now)
     replica_comparison(store, now, args.window_s)
     slo_status(store, engine, now)
+    autoscale_section(store, now, args.window_s)
     timeline(store, args.events)
     return 0
 
