@@ -300,6 +300,19 @@ def lint_main() -> None:
         baseline=baseline_path if os.path.isfile(baseline_path) else None,
     )
     elapsed = time.monotonic() - t0
+    # per-family rollup (RTL1..RTL7) so bench_gate/fleet_report can watch the
+    # finding trajectory of the concurrency/fleet families independently of
+    # the older JAX-footgun families
+    families = {}
+    for code in RULE_CATALOG:
+        fam = code[:4]
+        families.setdefault(
+            fam, {"rules": 0, "findings": 0, "new": 0}
+        )["rules"] += 1
+    for f in report.findings:
+        families[f.code[:4]]["findings"] += 1
+    for f in report.new:
+        families[f.code[:4]]["new"] += 1
     result = {
         "bench": "lint",
         "metric": "relora-lint findings over relora_tpu/",
@@ -315,6 +328,7 @@ def lint_main() -> None:
             "baseline_size": report.baselined + len(report.stale_baseline),
             "stale_baseline": len(report.stale_baseline),
             "by_rule": report.rule_counts,
+            "by_family": {fam: families[fam] for fam in sorted(families)},
             "elapsed_sec": round(elapsed, 3),
         },
     }

@@ -9,6 +9,11 @@ Rule families (full catalog in ``docs/static-analysis.md``):
 - RTL3xx donation/aliasing (use-after-donation, missing donate_argnums)
 - RTL4xx RNG hygiene (key reuse, entropy-seeded keys)
 - RTL5xx pytree/sharding (in-place params mutation, spec-less shard_map)
+- RTL6xx concurrency (cross-thread writes without a common lock, blocking
+  calls in async bodies, asyncio mutation off the loop, lock-order cycles)
+- RTL7xx fleet consistency (consumed-but-never-produced series/event names,
+  counters missing zero materialization, unknown fault sites) — a
+  project-wide pass over the whole-repo symbol table/call graph
 
 Usage::
 
@@ -20,12 +25,17 @@ bare interpreter (CI lint stage) in milliseconds.
 
 from relora_tpu.analysis.core import (  # noqa: F401  (re-exports)
     CHECKERS,
+    PROJECT_CHECKERS,
     RULE_CATALOG,
     BaselineEntry,
     FileContext,
     Finding,
+    ModuleIndex,
+    ProjectIndex,
     Report,
+    build_project_index,
     format_baseline_entry,
+    get_module_index,
     lint_paths,
     lint_text,
     load_baseline,
@@ -33,7 +43,9 @@ from relora_tpu.analysis.core import (  # noqa: F401  (re-exports)
 
 # importing the rule modules registers their checkers/catalog entries
 from relora_tpu.analysis import (  # noqa: F401
+    rules_concurrency,
     rules_donation,
+    rules_fleet,
     rules_hostsync,
     rules_pytree,
     rules_retrace,
@@ -42,12 +54,17 @@ from relora_tpu.analysis import (  # noqa: F401
 
 __all__ = [
     "CHECKERS",
+    "PROJECT_CHECKERS",
     "RULE_CATALOG",
     "BaselineEntry",
     "FileContext",
     "Finding",
+    "ModuleIndex",
+    "ProjectIndex",
     "Report",
+    "build_project_index",
     "format_baseline_entry",
+    "get_module_index",
     "lint_paths",
     "lint_text",
     "load_baseline",

@@ -51,6 +51,20 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
     parser.add_argument(
+        "--family",
+        action="append",
+        default=None,
+        metavar="RTL#",
+        help="only report findings/stale entries whose code starts with this "
+        "prefix (repeatable, e.g. --family RTL6 --family RTL7)",
+    )
+    parser.add_argument(
+        "--call-graph-dump",
+        action="store_true",
+        help="print the project symbol table (thread roots + resolved call "
+        "edges per module) instead of linting",
+    )
+    parser.add_argument(
         "--root",
         default=str(REPO_ROOT),
         help="root for repo-relative paths (default: %(default)s)",
@@ -63,6 +77,23 @@ def main(argv=None) -> int:
         return 0
 
     paths = args.paths or [str(REPO_ROOT / "relora_tpu")]
+
+    if args.call_graph_dump:
+        from relora_tpu.analysis.core import FileContext, ProjectIndex, _iter_py_files
+        import os
+
+        contexts = {}
+        for path in paths:
+            for fpath in _iter_py_files(path):
+                rel = os.path.relpath(os.path.abspath(fpath), args.root)
+                try:
+                    with open(fpath, encoding="utf-8") as fh:
+                        contexts[rel] = FileContext(fpath, rel, fh.read())
+                except (SyntaxError, UnicodeDecodeError) as e:
+                    print(f"parse error: {rel}: {e}", file=sys.stderr)
+        print(ProjectIndex(contexts).call_graph_dump())
+        return 0
+
     baseline = None
     if not args.no_baseline and Path(args.baseline).is_file():
         baseline = args.baseline
@@ -72,6 +103,13 @@ def main(argv=None) -> int:
     except ValueError as e:  # malformed baseline
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.family:
+        prefixes = tuple(p.upper() for p in args.family)
+        report.new = [f for f in report.new if f.code.startswith(prefixes)]
+        report.stale_baseline = [
+            e for e in report.stale_baseline if e.code.startswith(prefixes)
+        ]
 
     for f in report.new:
         print(f.render())

@@ -100,6 +100,39 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
     # by contract — hot registration keeps device syncs out of both
     "relora_tpu/obs/compile.py": [""],
     "relora_tpu/obs/memory.py": [""],
+    # fleet-tier entry points (PR-18 drift fix): these run once per scrape /
+    # scale decision / monitor tick, not per decode step, but they execute on
+    # dedicated threads next to the model loop — a device sync or hot-loop
+    # footgun here stalls the serving plane just the same.  Registration also
+    # puts them under the RTL6xx thread-root analysis via the call graph.
+    "relora_tpu/serve/autoscale.py": [
+        "Autoscaler._loop",
+        "Autoscaler.step",
+        "AutoscalerPolicy.decide",
+    ],
+    "relora_tpu/serve/deploy.py": [
+        "CheckpointWatcher._run",
+        "CheckpointWatcher.poll_once",
+        "RollingUpdater.run",
+    ],
+    "relora_tpu/serve/supervisor.py": [
+        "ReplicaSupervisor.scale_up",
+        "ReplicaSupervisor.scale_down",
+        "ReplicaSupervisor._monitor_loop",
+        "ReplicaSupervisor._check",
+    ],
+    "relora_tpu/train/elastic.py": [
+        "reshard_tree",
+        "restore_resharded",
+    ],
+    "relora_tpu/obs/fleet.py": [
+        "FleetCollector._loop",
+        "FleetCollector.scrape_once",
+        "FleetCollector._scrape_target",
+        "FleetCollector._ingest_metrics",
+        "SeriesStore.add_samples",
+        "SeriesStore.add_event",
+    ],
 }
 
 HOT_MARKER = "relora-lint: hot-path"

@@ -1,0 +1,562 @@
+"""RTL6xx — concurrency discipline across the serving tier's thread roots.
+
+The serving plane mixes three execution domains: asyncio handlers on the
+event loop, dedicated worker threads (`threading.Thread(target=...)` — the
+model loop, watchdog, supervisor monitor, autoscaler, checkpoint watcher),
+and executor jobs (`loop.run_in_executor`).  The rules here are driven by
+the module call graph (:class:`~relora_tpu.analysis.core.ModuleIndex`):
+thread entry points are inferred from `Thread(target=...)` /
+`run_in_executor` / `signal` registrations plus `async def` handlers, and
+every method is attributed to the *root group* that reaches it — spawned
+roots each form their own group, while async handlers, signal handlers and
+otherwise-unclaimed public methods form the ambient "main" group (external
+callers run them on the main/event-loop thread).
+
+- RTL601: instance attribute rebound from two different root groups with no
+  lock held in common across the write sites (lock-set inference over
+  ``with self._lock:`` scopes).  Rebinding only — ``.append``/subscript
+  mutation is out of scope, and ``__init__`` writes are exempt (happen
+  before any thread is spawned).
+- RTL602: blocking call inside an ``async def`` body — ``time.sleep``,
+  sync-primitive ``.wait()``/``.get()``/``.put()`` without a timeout,
+  socket/urllib/subprocess, or a jitted engine/scheduler step.  Blessed:
+  ``await asyncio.sleep`` and ``run_in_executor(None, fn)`` (the callable is
+  passed, not called).
+- RTL603: asyncio object (``asyncio.Event``/``asyncio.Queue`` attribute)
+  mutated from code reachable from a thread/executor/signal root.  Blessed:
+  ``loop.call_soon_threadsafe(evt.set)`` — again passed, not called.
+- RTL604: lock-acquisition-order cycle in a class's static acquire graph
+  (nested ``with`` plus one call level).  The `_scale_lock`-vs-drain shape:
+  two methods taking the same two locks in opposite orders deadlock under
+  concurrency even though each is individually correct.
+- RTL605: ``Thread(target=...)``/``run_in_executor`` pointed at an
+  ``async def`` — the call returns an un-awaited coroutine and the "thread"
+  silently does nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from relora_tpu.analysis.core import (
+    LOCK_FACTORIES,
+    THREAD_FACTORIES,
+    FileContext,
+    Finding,
+    ModuleIndex,
+    catalog,
+    checker,
+    dotted_name,
+    get_kwarg,
+    get_module_index,
+    target_path,
+)
+
+catalog(
+    RTL601="attribute written from two thread roots with no common lock (data race)",
+    RTL602="blocking call inside an async def body (stalls the event loop)",
+    RTL603="cross-thread asyncio mutation not routed through call_soon_threadsafe",
+    RTL604="lock acquisition order cycle (static deadlock shape)",
+    RTL605="Thread/executor target is an async def (coroutine is never awaited)",
+)
+
+#: dotted calls that block the calling thread outright
+BLOCKING_CALLS = frozenset(
+    {
+        "time.sleep",
+        "socket.create_connection",
+        "socket.getaddrinfo",
+        "urllib.request.urlopen",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "requests.get",
+        "requests.post",
+        "requests.request",
+    }
+)
+
+#: factories whose instances have blocking .get/.put/.wait/.join/.acquire
+SYNC_PRIMITIVE_FACTORIES = frozenset(
+    {
+        "queue.Queue",
+        "queue.SimpleQueue",
+        "queue.LifoQueue",
+        "queue.PriorityQueue",
+        "threading.Event",
+        "threading.Thread",
+        "threading.Lock",
+        "threading.RLock",
+        "threading.Condition",
+        "threading.Semaphore",
+    }
+)
+BLOCKING_METHODS = frozenset({"get", "put", "wait", "join", "acquire"})
+
+ASYNCIO_FACTORIES = frozenset({"asyncio.Event", "asyncio.Queue", "asyncio.Condition"})
+ASYNCIO_MUTATORS = frozenset({"set", "clear", "put", "put_nowait"})
+
+#: method names that dispatch into jitted device code on the serving engine
+ENGINE_BLOCKING_METHODS = frozenset(
+    {"step", "prefill", "decode", "insert", "decode_paged", "prefill_chunk"}
+)
+ENGINE_RECEIVER_HINTS = ("engine", "sched")
+
+
+def _lock_attrs(mi: ModuleIndex, cls: str) -> FrozenSet[str]:
+    return frozenset(
+        attr
+        for attr, fac in mi.attr_types.get(cls, {}).items()
+        if fac in LOCK_FACTORIES
+    )
+
+
+def _class_methods(mi: ModuleIndex, cls: str) -> Set[str]:
+    return {qn for qn, fi in mi.functions.items() if fi.owner_class == cls}
+
+
+def _root_groups(mi: ModuleIndex, cls: str) -> Dict[str, Set[str]]:
+    """Root-group id -> methods of *cls* that group's thread can execute.
+    Spawned roots (thread/executor) each get their own group; async
+    handlers, signal handlers, and public methods not claimed by a spawned
+    root form the ambient "main" group."""
+    methods = _class_methods(mi, cls)
+    groups: Dict[str, Set[str]] = {}
+    spawned_reach: Set[str] = set()
+    for qn, kind in sorted(mi.thread_roots.items()):
+        if qn in methods and kind in ("thread", "executor"):
+            reach = mi.reachable([qn]) & methods
+            groups[f"{kind}:{qn}"] = reach
+            spawned_reach |= reach
+    main_entries = {
+        qn
+        for qn in methods
+        if (
+            not qn.rsplit(".", 1)[-1].startswith("_")
+            or mi.thread_roots.get(qn) in ("async", "signal")
+        )
+        and qn not in spawned_reach
+    }
+    main = mi.reachable(main_entries) & methods
+    if main:
+        groups["main"] = main
+    return groups
+
+
+class _MethodFacts(ast.NodeVisitor):
+    """Per-method facts: self-attribute writes with held lock sets, lock
+    acquire nesting edges, and locks acquired at any depth.  Does not
+    descend into nested function/class definitions (those are separate
+    entries in the module index)."""
+
+    def __init__(self, lock_attrs: FrozenSet[str]) -> None:
+        self.lock_attrs = lock_attrs
+        self.held: List[str] = []
+        # attr -> list of (frozenset(held locks), anchor node)
+        self.writes: Dict[str, List[Tuple[FrozenSet[str], ast.AST]]] = {}
+        # (outer lock, inner lock, anchor node) for nested acquires
+        self.acquire_edges: List[Tuple[str, str, ast.AST]] = []
+        self.acquired: Set[str] = set()
+        # (resolved dotted callee, frozenset(held locks)) for call edges
+        self.calls_holding: List[Tuple[str, FrozenSet[str]]] = []
+        self._root: Optional[ast.AST] = None
+
+    def run(self, func_node: ast.AST) -> "_MethodFacts":
+        self._root = func_node
+        for stmt in getattr(func_node, "body", []):
+            self.visit(stmt)
+        return self
+
+    def _skip(self, node: ast.AST) -> None:  # nested defs are separate scopes
+        return
+
+    visit_FunctionDef = _skip
+    visit_AsyncFunctionDef = _skip
+    visit_ClassDef = _skip
+
+    def _with_locks(self, node) -> List[str]:
+        locks = []
+        for item in node.items:
+            path = target_path(item.context_expr)
+            if path.startswith("self.") and path.split(".", 1)[1] in self.lock_attrs:
+                locks.append(path.split(".", 1)[1])
+        return locks
+
+    def _visit_with(self, node) -> None:
+        locks = self._with_locks(node)
+        for lock in locks:
+            for outer in self.held:
+                if outer != lock:
+                    self.acquire_edges.append((outer, lock, node))
+            self.acquired.add(lock)
+            self.held.append(lock)
+        self.generic_visit(node)
+        for lock in locks:
+            self.held.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _record_write(self, target: ast.AST, anchor: ast.AST) -> None:
+        path = target_path(target)
+        if path.startswith("self.") and path.count(".") == 1:
+            attr = path.split(".", 1)[1]
+            self.writes.setdefault(attr, []).append((frozenset(self.held), anchor))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record_write(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func)
+        if dotted:
+            self.calls_holding.append((dotted, frozenset(self.held)))
+        self.generic_visit(node)
+
+
+def _method_facts(
+    mi: ModuleIndex, cls: str
+) -> Dict[str, _MethodFacts]:
+    locks = _lock_attrs(mi, cls)
+    facts = {}
+    for qn in _class_methods(mi, cls):
+        facts[qn] = _MethodFacts(locks).run(mi.functions[qn].node)
+    return facts
+
+
+def _check_shared_writes(
+    ctx: FileContext, mi: ModuleIndex, cls: str, facts: Dict[str, _MethodFacts]
+) -> List[Finding]:
+    groups = _root_groups(mi, cls)
+    if len(groups) < 2:
+        return []
+    findings: List[Finding] = []
+    # attr -> group -> write sites (init-time writes exempt: they happen
+    # before any thread exists)
+    per_attr: Dict[str, Dict[str, List[Tuple[FrozenSet[str], ast.AST]]]] = {}
+    for group, methods in groups.items():
+        for qn in methods:
+            if qn.rsplit(".", 1)[-1] in ("__init__", "__post_init__"):
+                continue
+            for attr, sites in facts[qn].writes.items():
+                per_attr.setdefault(attr, {}).setdefault(group, []).extend(sites)
+    for attr in sorted(per_attr):
+        by_group = per_attr[attr]
+        if len(by_group) < 2:
+            continue
+        all_sites = [s for sites in by_group.values() for s in sites]
+        common = frozenset.intersection(*(locks for locks, _ in all_sites))
+        if common:
+            continue
+        # anchor at a spawned-thread write site when there is one
+        anchor = None
+        for group in sorted(by_group):
+            if group != "main":
+                anchor = by_group[group][0][1]
+                break
+        if anchor is None:
+            anchor = all_sites[0][1]
+        names = " and ".join(sorted(by_group))
+        findings.append(
+            ctx.finding(
+                anchor,
+                "RTL601",
+                f"self.{attr} is written from {names} with no common lock — "
+                "guard every write with one lock or confine writes to a "
+                "single thread",
+            )
+        )
+    return findings
+
+
+def _check_lock_order(
+    ctx: FileContext, mi: ModuleIndex, cls: str, facts: Dict[str, _MethodFacts]
+) -> List[Finding]:
+    # static acquire graph: nested `with` edges plus one call level (a
+    # method called while holding L acquires its own locks under L)
+    edges: Dict[str, Set[str]] = {}
+    anchors: Dict[Tuple[str, str], ast.AST] = {}
+    for qn, f in facts.items():
+        for outer, inner, node in f.acquire_edges:
+            edges.setdefault(outer, set()).add(inner)
+            anchors.setdefault((outer, inner), node)
+        for dotted, held in f.calls_holding:
+            if not held:
+                continue
+            callee = mi.resolve_local(dotted, qn)
+            if callee is None or callee not in facts:
+                continue
+            for inner in facts[callee].acquired:
+                for outer in held:
+                    if outer != inner:
+                        edges.setdefault(outer, set()).add(inner)
+                        anchors.setdefault(
+                            (outer, inner), mi.functions[callee].node
+                        )
+    findings: List[Finding] = []
+    seen_cycles: Set[FrozenSet[str]] = set()
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycle = path + [start]
+                    anchor = anchors.get((path[-1], start)) or anchors.get(
+                        (path[0], path[1])
+                    )
+                    findings.append(
+                        ctx.finding(
+                            anchor,
+                            "RTL604",
+                            f"lock order cycle in {cls}: "
+                            + " -> ".join(f"self.{l}" for l in cycle)
+                            + " — pick one global order and acquire both "
+                            "locks in it everywhere",
+                        )
+                    )
+            elif nxt not in path:
+                dfs(start, nxt, path + [nxt])
+
+    for lock in sorted(edges):
+        dfs(lock, lock, [lock])
+    return findings
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """RTL602 over one async function body."""
+
+    def __init__(self, ctx: FileContext, mi: ModuleIndex, cls: str) -> None:
+        self.ctx = ctx
+        self.mi = mi
+        self.cls = cls
+        self.findings: List[Finding] = []
+        self._root: Optional[ast.AST] = None
+
+    def run(self, func_node: ast.AST) -> List[Finding]:
+        self._root = func_node
+        for stmt in func_node.body:
+            self.visit(stmt)
+        return self.findings
+
+    def _skip(self, node: ast.AST) -> None:
+        return
+
+    visit_FunctionDef = _skip
+    visit_AsyncFunctionDef = _skip
+    visit_ClassDef = _skip
+
+    def _attr_factory(self, recv: ast.AST) -> str:
+        path = target_path(recv)
+        if path.startswith("self.") and path.count(".") == 1:
+            return self.mi.attr_types.get(self.cls, {}).get(path.split(".", 1)[1], "")
+        if path and "." not in path:
+            return self.mi.module_types.get(path, "")
+        return ""
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func)
+        if dotted in BLOCKING_CALLS:
+            hint = (
+                "use await asyncio.sleep(...)"
+                if dotted == "time.sleep"
+                else "move it to run_in_executor"
+            )
+            self.findings.append(
+                self.ctx.finding(
+                    node,
+                    "RTL602",
+                    f"{dotted}() inside an async def blocks the event loop "
+                    f"(every other stream stalls) — {hint}",
+                )
+            )
+        elif isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth in BLOCKING_METHODS:
+                factory = self._attr_factory(node.func.value)
+                if factory in SYNC_PRIMITIVE_FACTORIES and (
+                    get_kwarg(node, "timeout") is None
+                ):
+                    self.findings.append(
+                        self.ctx.finding(
+                            node,
+                            "RTL602",
+                            f".{meth}() on a {factory} inside an async def "
+                            "with no timeout — blocks the event loop; use "
+                            "run_in_executor or an asyncio primitive",
+                        )
+                    )
+            if meth in ENGINE_BLOCKING_METHODS:
+                recv = dotted_name(node.func.value)
+                parts = recv.split(".") if recv else []
+                if any(h in p for p in parts for h in ENGINE_RECEIVER_HINTS):
+                    self.findings.append(
+                        self.ctx.finding(
+                            node,
+                            "RTL602",
+                            f"jitted engine call {recv}.{meth}() inside an "
+                            "async def — device dispatch blocks the event "
+                            "loop; route it through the model thread queue",
+                        )
+                    )
+        self.generic_visit(node)
+
+
+def _check_async_blocking(ctx: FileContext, mi: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for qn, fi in sorted(mi.functions.items()):
+        if fi.is_async:
+            findings.extend(_AsyncBodyVisitor(ctx, mi, fi.owner_class).run(fi.node))
+    return findings
+
+
+class _AsyncioMutationVisitor(ast.NodeVisitor):
+    """RTL603 over one thread-side function body."""
+
+    def __init__(self, ctx: FileContext, mi: ModuleIndex, cls: str, root: str) -> None:
+        self.ctx = ctx
+        self.mi = mi
+        self.cls = cls
+        self.root = root
+        self.findings: List[Finding] = []
+
+    def run(self, func_node: ast.AST) -> List[Finding]:
+        for stmt in getattr(func_node, "body", []):
+            self.visit(stmt)
+        return self.findings
+
+    def _skip(self, node: ast.AST) -> None:
+        return
+
+    visit_FunctionDef = _skip
+    visit_AsyncFunctionDef = _skip
+    visit_ClassDef = _skip
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ASYNCIO_MUTATORS
+        ):
+            path = target_path(node.func.value)
+            factory = ""
+            if path.startswith("self.") and path.count(".") == 1:
+                factory = self.mi.attr_types.get(self.cls, {}).get(
+                    path.split(".", 1)[1], ""
+                )
+            elif path and "." not in path:
+                factory = self.mi.module_types.get(path, "")
+            if factory in ASYNCIO_FACTORIES:
+                self.findings.append(
+                    self.ctx.finding(
+                        node,
+                        "RTL603",
+                        f"{path}.{node.func.attr}() from {self.root} mutates "
+                        "an asyncio object off the event loop — route it "
+                        "through loop.call_soon_threadsafe(...)",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def _check_cross_thread_asyncio(ctx: FileContext, mi: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    spawned = {
+        qn: kind
+        for qn, kind in mi.thread_roots.items()
+        if kind in ("thread", "executor", "signal") and qn in mi.functions
+    }
+    if not spawned:
+        return findings
+    seen: Set[str] = set()
+    for root, kind in sorted(spawned.items()):
+        label = f"the {root} {('signal handler' if kind == 'signal' else kind)}"
+        for qn in sorted(mi.reachable([root])):
+            if qn in seen:
+                continue
+            seen.add(qn)
+            fi = mi.functions[qn]
+            if fi.is_async:
+                continue
+            findings.extend(
+                _AsyncioMutationVisitor(ctx, mi, fi.owner_class, label).run(fi.node)
+            )
+    return findings
+
+
+class _RootTargetVisitor(ast.NodeVisitor):
+    """RTL605: Thread/executor registrations pointed at async defs."""
+
+    def __init__(self, ctx: FileContext, mi: ModuleIndex) -> None:
+        super().__init__()
+        self.ctx = ctx
+        self.mi = mi
+        self.stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func)
+        basename = dotted.rsplit(".", 1)[-1] if dotted else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        )
+        target: Optional[ast.AST] = None
+        what = ""
+        if dotted in THREAD_FACTORIES:
+            target, what = get_kwarg(node, "target"), dotted
+        elif basename == "run_in_executor" and len(node.args) >= 2:
+            target, what = node.args[1], "run_in_executor"
+        if target is not None:
+            tgt = dotted_name(target)
+            resolved = self.mi.resolve_local(tgt, ".".join(self.stack)) if tgt else None
+            if resolved is not None and self.mi.functions[resolved].is_async:
+                self.findings.append(
+                    self.ctx.finding(
+                        node,
+                        "RTL605",
+                        f"{what} target {tgt} is an async def — calling it "
+                        "returns an un-awaited coroutine and the worker does "
+                        "nothing; make it sync or schedule it on the loop",
+                    )
+                )
+        self.generic_visit(node)
+
+
+@checker
+def check_concurrency(ctx: FileContext) -> List[Finding]:
+    mi = get_module_index(ctx)
+    findings: List[Finding] = []
+    for cls in sorted(mi.classes):
+        facts = _method_facts(mi, cls)
+        findings.extend(_check_shared_writes(ctx, mi, cls, facts))
+        findings.extend(_check_lock_order(ctx, mi, cls, facts))
+    findings.extend(_check_async_blocking(ctx, mi))
+    findings.extend(_check_cross_thread_asyncio(ctx, mi))
+    rt = _RootTargetVisitor(ctx, mi)
+    rt.visit(ctx.tree)
+    findings.extend(rt.findings)
+    return findings

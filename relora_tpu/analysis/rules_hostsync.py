@@ -34,6 +34,7 @@ from relora_tpu.analysis.core import (
     catalog,
     checker,
     dotted_name,
+    get_module_index,
 )
 from relora_tpu.analysis.hotpaths import hot_prefixes, qualname_is_hot
 
@@ -159,11 +160,41 @@ class _HotVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _propagated_prefixes(ctx: FileContext, prefixes) -> List[str]:
+    """One-level call-graph propagation: a helper invoked *unconditionally*
+    from a hot function is hot too (it runs every step).  Conditional calls
+    are exempt — that is exactly the sanctioned cadence-gating idiom
+    (``if len(pending) >= log_every: self._pull_metric_records(...)``), so
+    the gate stays meaningful.  One level only, same module only."""
+    mi = get_module_index(ctx)
+    extra = set()
+    for qualname in mi.functions:
+        if not qualname_is_hot(qualname, prefixes):
+            continue
+        # a closure nested in a hot function only propagates if the closure
+        # itself is invoked unconditionally there: a cadence-gated flush
+        # closure (`if pending >= log_every: flush()`) must not drag the
+        # sanctioned bulk-pull helper into the hot set
+        parent = qualname.rsplit(".", 1)[0] if "." in qualname else ""
+        if (
+            parent in mi.functions
+            and qualname_is_hot(parent, prefixes)
+            and qualname not in mi.uncond_calls.get(parent, set())
+        ):
+            continue
+        for callee in mi.uncond_calls.get(qualname, ()):
+            if not qualname_is_hot(callee, prefixes):
+                extra.add(callee)
+    return list(prefixes) + sorted(extra)
+
+
 @checker
 def check_hostsync(ctx: FileContext) -> List[Finding]:
     prefixes = hot_prefixes(ctx)
     if not prefixes:
         return []
+    if "" not in prefixes:
+        prefixes = _propagated_prefixes(ctx, prefixes)
     visitor = _HotVisitor(ctx, prefixes)
     visitor.visit(ctx.tree)
     return visitor.findings

@@ -253,6 +253,12 @@ class GenerateServer:
             self.stats.inc("adapter_evictions_total", by=0)
             self.stats.set_gauge("adapter_slots_used", registry.slots_used())
             self.stats.materialize_histogram("adapter_load_seconds")
+        # the collector's error_rate is derived from requests_finished_total
+        # deltas; materialize the counter at zero so a replica that has not
+        # finished a request yet still exports error_rate = 0.0 (absent
+        # series would blind the SLO engine during warmup)
+        self.stats.inc("requests_finished_total", ("reason", "stop"), 0)
+        self.stats.inc("requests_finished_total", ("reason", "error"), 0)
         self.default_max_new_tokens = default_max_new_tokens
         self.default_temperature = default_temperature
         self.default_top_p = default_top_p

@@ -465,13 +465,652 @@ def test_rtl502_explicit_specs_ok():
 
 
 # ---------------------------------------------------------------------------
+# call graph: thread-root inference, resolution, one-level propagation
+
+
+def _index(src: str):
+    from relora_tpu.analysis import get_module_index
+    from relora_tpu.analysis.core import FileContext
+
+    return get_module_index(FileContext("m.py", "m.py", textwrap.dedent(src)))
+
+
+def test_module_index_infers_all_root_kinds():
+    src = """
+        import asyncio
+        import signal
+        import threading
+
+        def on_term(signum, frame):
+            pass
+
+        signal.signal(signal.SIGTERM, on_term)
+
+        class Server:
+            def __init__(self, loop):
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+                loop.run_in_executor(None, self._scrape)
+
+            def _loop(self):
+                pass
+
+            def _scrape(self):
+                pass
+
+            async def handle(self, request):
+                pass
+    """
+    mi = _index(src)
+    assert mi.thread_roots["Server._loop"] == "thread"
+    assert mi.thread_roots["Server._scrape"] == "executor"
+    assert mi.thread_roots["on_term"] == "signal"
+    assert mi.thread_roots["Server.handle"] == "async"
+
+
+def test_module_index_resolves_self_bare_and_qualified():
+    src = """
+        def helper():
+            pass
+
+        class C:
+            def outer(self):
+                def inner():
+                    pass
+                inner()
+                helper()
+                self.meth()
+
+            def meth(self):
+                pass
+    """
+    mi = _index(src)
+    assert mi.resolve_local("inner", "C.outer") == "C.outer.inner"
+    assert mi.resolve_local("helper", "C.outer") == "helper"
+    assert mi.resolve_local("self.meth", "C.outer") == "C.meth"
+    assert mi.resolve_local("C.meth", "") == "C.meth"
+    assert mi.resolve_local("self.nope", "C.outer") is None
+
+
+def test_module_index_reachability_is_transitive():
+    src = """
+        class C:
+            def a(self):
+                self.b()
+
+            def b(self):
+                self.c()
+
+            def c(self):
+                pass
+
+            def d(self):
+                pass
+    """
+    mi = _index(src)
+    assert mi.reachable(["C.a"]) == {"C.a", "C.b", "C.c"}
+    assert "C.d" not in mi.reachable(["C.a"])
+
+
+def test_rtl2xx_propagates_to_unconditional_helper():
+    # `_log` is not in the hot-prefix table, but it is called
+    # unconditionally from Trainer.fit — the .item() inside it runs every
+    # step and must fire
+    src = """
+        class Trainer:
+            def fit(self, batches):
+                for batch in batches:
+                    loss = self.state.loss
+                    self._log(loss)
+
+            def _log(self, loss):
+                return loss.item()
+    """
+    found = lint_text(
+        textwrap.dedent(src), relpath="relora_tpu/train/trainer.py"
+    )
+    assert "RTL201" in [f.code for f in found]
+
+
+def test_rtl2xx_no_propagation_through_conditional_call():
+    # the sanctioned cadence-gating idiom: a bulk-pull helper behind an
+    # `if len(pending) >= log_every` gate (possibly via a nested closure)
+    # must NOT become hot
+    src = """
+        class Trainer:
+            def fit(self, batches, log_every=32):
+                pending = []
+
+                def flush():
+                    self._pull(pending)
+
+                for batch in batches:
+                    pending.append(batch)
+                    if len(pending) >= log_every:
+                        flush()
+
+            def _pull(self, pending):
+                return [p.item() for p in pending]
+    """
+    found = lint_text(
+        textwrap.dedent(src), relpath="relora_tpu/train/trainer.py"
+    )
+    assert [f.code for f in found] == []
+
+
+# ---------------------------------------------------------------------------
+# RTL6xx concurrency discipline
+
+
+def test_rtl601_cross_thread_write_fires():
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+
+            def _loop(self):
+                while True:
+                    self.count = self.count + 1
+
+            def reset(self):
+                self.count = 0
+    """
+    assert "RTL601" in codes(src)
+
+
+def test_rtl601_common_lock_ok():
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self.count = self.count + 1
+
+            def reset(self):
+                with self._lock:
+                    self.count = 0
+    """
+    assert "RTL601" not in codes(src)
+
+
+def test_rtl601_single_writer_ok():
+    # writes confined to the spawned thread (init-time writes are exempt:
+    # they happen before the thread exists)
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+
+            def _loop(self):
+                while True:
+                    self.count = self.count + 1
+
+            def snapshot(self):
+                return self.count
+    """
+    assert "RTL601" not in codes(src)
+
+
+def test_rtl602_time_sleep_in_async_fires():
+    src = """
+        import time
+
+        class Handler:
+            async def handle(self, request):
+                time.sleep(0.1)
+                return request
+    """
+    assert "RTL602" in codes(src)
+
+
+def test_rtl602_queue_get_without_timeout_fires():
+    src = """
+        import queue
+
+        class Handler:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            async def handle(self):
+                return self._q.get()
+    """
+    assert "RTL602" in codes(src)
+
+
+def test_rtl602_engine_step_in_async_fires():
+    src = """
+        class Handler:
+            async def handle(self, tokens):
+                return self.engine.decode(tokens)
+    """
+    assert "RTL602" in codes(src)
+
+
+def test_rtl602_blessed_idioms_ok():
+    # await asyncio.sleep, a timeout-bounded get, and passing (not calling)
+    # a blocking callable into run_in_executor are all fine
+    src = """
+        import asyncio
+        import queue
+
+        class Handler:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            async def handle(self, loop):
+                await asyncio.sleep(0.1)
+                item = self._q.get(timeout=1.0)
+                return await loop.run_in_executor(None, self._q.get)
+    """
+    assert "RTL602" not in codes(src)
+
+
+def test_rtl603_asyncio_event_set_from_thread_fires():
+    src = """
+        import asyncio
+        import threading
+
+        class Shutdown:
+            def __init__(self):
+                self._done = asyncio.Event()
+                self._thread = threading.Thread(target=self._work)
+
+            def _work(self):
+                self._done.set()
+    """
+    assert "RTL603" in codes(src)
+
+
+def test_rtl603_call_soon_threadsafe_ok():
+    src = """
+        import asyncio
+        import threading
+
+        class Shutdown:
+            def __init__(self, loop):
+                self._done = asyncio.Event()
+                self._loop = loop
+                self._thread = threading.Thread(target=self._work)
+
+            def _work(self):
+                self._loop.call_soon_threadsafe(self._done.set)
+    """
+    assert "RTL603" not in codes(src)
+
+
+def test_rtl604_opposite_lock_order_fires():
+    src = """
+        import threading
+
+        class Drain:
+            def __init__(self):
+                self._scale_lock = threading.Lock()
+                self._queue_lock = threading.Lock()
+
+            def scale_down(self):
+                with self._scale_lock:
+                    with self._queue_lock:
+                        pass
+
+            def drain(self):
+                with self._queue_lock:
+                    with self._scale_lock:
+                        pass
+    """
+    assert "RTL604" in codes(src)
+
+
+def test_rtl604_cycle_through_call_level_fires():
+    # drain() acquires the queue lock while a held scale lock is one call
+    # away — the cycle only exists through the call edge
+    src = """
+        import threading
+
+        class Drain:
+            def __init__(self):
+                self._scale_lock = threading.Lock()
+                self._queue_lock = threading.Lock()
+
+            def scale_down(self):
+                with self._scale_lock:
+                    self._drain_locked()
+
+            def _drain_locked(self):
+                with self._queue_lock:
+                    pass
+
+            def drain(self):
+                with self._queue_lock:
+                    with self._scale_lock:
+                        pass
+    """
+    assert "RTL604" in codes(src)
+
+
+def test_rtl604_consistent_order_ok():
+    src = """
+        import threading
+
+        class Drain:
+            def __init__(self):
+                self._scale_lock = threading.Lock()
+                self._queue_lock = threading.Lock()
+
+            def scale_down(self):
+                with self._scale_lock:
+                    with self._queue_lock:
+                        pass
+
+            def drain(self):
+                with self._scale_lock:
+                    with self._queue_lock:
+                        pass
+    """
+    assert "RTL604" not in codes(src)
+
+
+def test_rtl604_reentrant_same_lock_ok():
+    src = """
+        import threading
+
+        class Reentrant:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """
+    assert "RTL604" not in codes(src)
+
+
+def test_rtl605_thread_target_async_def_fires():
+    src = """
+        import threading
+
+        class Runner:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            async def _run(self):
+                pass
+    """
+    assert "RTL605" in codes(src)
+
+
+def test_rtl605_sync_target_ok():
+    src = """
+        import threading
+
+        class Runner:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                pass
+    """
+    assert "RTL605" not in codes(src)
+
+
+# ---------------------------------------------------------------------------
+# RTL7xx fleet-plane consistency (project pass over fixture trees)
+
+
+def fleet_codes(files):
+    from relora_tpu.analysis import build_project_index
+    from relora_tpu.analysis.rules_fleet import fleet_findings
+
+    return [f.code for f in fleet_findings(build_project_index(files))]
+
+
+PRODUCER_SRC = textwrap.dedent(
+    """
+    class MetricsRegistry:
+        def __init__(self, namespace="relora_serve"):
+            self.namespace = namespace
+
+        def tick(self):
+            self.inc("requests_total")
+    """
+)
+
+
+def test_rtl701_seeded_typo_in_report_columns_fires():
+    # the acceptance fixture: one typo'd series name in a report table must
+    # fail the pass
+    files = {
+        "relora_tpu/serve/metrics.py": PRODUCER_SRC,
+        "tools/fleet_report.py": textwrap.dedent(
+            """
+            _COMPARE_COLUMNS = (
+                ("req", "relora_serve_requests_totl", "{:.0f}"),
+            )
+            """
+        ),
+    }
+    assert "RTL701" in fleet_codes(files)
+
+
+def test_rtl701_matching_producer_ok():
+    files = {
+        "relora_tpu/serve/metrics.py": PRODUCER_SRC,
+        "tools/fleet_report.py": textwrap.dedent(
+            """
+            _COMPARE_COLUMNS = (
+                ("req", "relora_serve_requests_total", "{:.0f}"),
+            )
+            """
+        ),
+    }
+    assert "RTL701" not in fleet_codes(files)
+
+
+def test_rtl701_series_kwarg_and_derivation_suffix():
+    # `series=` kwargs are consumers; an `f"{name}_per_s"` store in a
+    # parse_prometheus module produces the derived name iff the base exists
+    collector = textwrap.dedent(
+        """
+        from relora_tpu.obs.parse_prometheus import parse_prometheus
+
+        def derive(flat, values):
+            for name, v in flat.items():
+                values[f"{name}_per_s"] = v
+        """
+    )
+    slo = textwrap.dedent(
+        """
+        def rules(SLO):
+            return [SLO(name="rps", series="relora_serve_requests_total_per_s")]
+        """
+    )
+    good = {
+        "relora_tpu/serve/metrics.py": PRODUCER_SRC,
+        "relora_tpu/obs/fleet.py": collector,
+        "relora_tpu/obs/slo.py": slo,
+    }
+    assert "RTL701" not in fleet_codes(good)
+    bad = dict(good)
+    del bad["relora_tpu/serve/metrics.py"]  # base counter never produced
+    assert "RTL701" in fleet_codes(bad)
+
+
+def test_rtl702_unemitted_event_kind_fires():
+    files = {
+        "relora_tpu/obs/deploy.py": textwrap.dedent(
+            """
+            def announce(store):
+                store.add_event("deploy_start", {})
+            """
+        ),
+        "tools/fleet_report.py": 'DEPLOY_KINDS = ("deploy_start", "deploy_done")\n',
+    }
+    assert "RTL702" in fleet_codes(files)
+
+
+def test_rtl702_emitted_kinds_ok_including_supervisor_prefix():
+    # supervisor-routed kinds are consumed under a `supervisor_` prefix but
+    # emitted bare through record_supervisor_event
+    files = {
+        "relora_tpu/obs/deploy.py": textwrap.dedent(
+            """
+            def announce(store):
+                store.add_event("deploy_start", {})
+                store.record_supervisor_event("restart", {})
+            """
+        ),
+        "tools/fleet_report.py": (
+            'DEPLOY_KINDS = ("deploy_start", "supervisor_restart")\n'
+        ),
+    }
+    assert "RTL702" not in fleet_codes(files)
+
+
+def test_rtl703_unmaterialized_delta_counter_fires():
+    collector = textwrap.dedent(
+        """
+        from relora_tpu.obs.parse_prometheus import parse_prometheus
+
+        def derive(flat, values):
+            for name, v in flat.items():
+                if name.endswith("requests_total"):
+                    values["requests_per_s"] = v
+        """
+    )
+    files = {
+        "relora_tpu/obs/fleet.py": collector,
+        "relora_tpu/serve/metrics.py": PRODUCER_SRC,
+    }
+    assert "RTL703" in fleet_codes(files)
+    # materializing the counter at zero satisfies the rule
+    zeroed = dict(files)
+    zeroed["relora_tpu/serve/server.py"] = textwrap.dedent(
+        """
+        def warmup(stats):
+            stats.inc("requests_total", 0)
+        """
+    )
+    assert "RTL703" not in fleet_codes(zeroed)
+
+
+def test_rtl704_fault_site_without_check_site_fires():
+    files = {
+        "relora_tpu/utils/boot.py": textwrap.dedent(
+            """
+            from relora_tpu.utils import faults
+
+            def setup():
+                faults.configure("scrape_drop", rate=0.5)
+            """
+        ),
+    }
+    assert "RTL704" in fleet_codes(files)
+    checked = dict(files)
+    checked["relora_tpu/obs/fleet.py"] = textwrap.dedent(
+        """
+        from relora_tpu.utils import faults
+
+        def scrape(target):
+            if faults.should("scrape_drop"):
+                return None
+            return target
+        """
+    )
+    assert "RTL704" not in fleet_codes(checked)
+
+
+def test_rtl704_env_fault_spec_is_a_consumer():
+    # RELORA_TPU_FAULTS env strings (site:param=value) configure sites too
+    files = {
+        "tests/test_resilience.py": textwrap.dedent(
+            """
+            import os
+
+            def test_preempt():
+                os.environ["RELORA_TPU_FAULTS"] = "ghost_site:rate=0.5"
+            """
+        ),
+    }
+    assert "RTL704" in fleet_codes(files)
+
+
+def test_rtl705_dead_event_emission_fires():
+    files = {
+        "relora_tpu/obs/deploy.py": textwrap.dedent(
+            """
+            def announce(store):
+                store.add_event("mystery_event", {})
+            """
+        ),
+    }
+    assert "RTL705" in fleet_codes(files)
+    consumed = dict(files)
+    consumed["tools/fleet_report.py"] = 'TIMELINE_KINDS = ("mystery_event",)\n'
+    assert "RTL705" not in fleet_codes(consumed)
+
+
+# ---------------------------------------------------------------------------
+# hotpaths drift guard: device-dispatch-shaped modules must be registered
+
+
+def test_hotpaths_registry_covers_dispatch_shaped_modules():
+    """A module in the serving/training/ops/obs planes that defines a
+    step/decode/prefill-shaped entry point or calls jax.jit/pjit must either
+    have a HOT_FUNCTIONS entry or carry the HOT_MARKER comment — otherwise
+    new hot code silently escapes the RTL2xx rules."""
+    import ast as ast_mod
+
+    from relora_tpu.analysis.core import dotted_name as dn
+    from relora_tpu.analysis.hotpaths import HOT_FUNCTIONS, HOT_MARKER
+
+    shaped_names = {"step", "decode", "prefill", "decode_paged", "prefill_chunk"}
+    jit_calls = {"jax.jit", "jax.pjit", "jit", "pjit"}
+    missing = []
+    for sub in ("serve", "train", "ops", "obs"):
+        for path in sorted((REPO_ROOT / "relora_tpu" / sub).glob("*.py")):
+            rel = path.relative_to(REPO_ROOT).as_posix()
+            text = path.read_text()
+            if rel in HOT_FUNCTIONS or HOT_MARKER in text:
+                continue
+            tree = ast_mod.parse(text)
+            shaped = any(
+                isinstance(n, (ast_mod.FunctionDef, ast_mod.AsyncFunctionDef))
+                and n.name in shaped_names
+                for n in ast_mod.walk(tree)
+            )
+            jitted = any(
+                isinstance(n, ast_mod.Call) and dn(n.func) in jit_calls
+                for n in ast_mod.walk(tree)
+            )
+            if shaped or jitted:
+                missing.append(rel)
+    assert missing == [], (
+        f"modules with dispatch-shaped code but no hotpaths registration: "
+        f"{missing} — add a HOT_FUNCTIONS entry (or the HOT_MARKER comment) "
+        "in relora_tpu/analysis/hotpaths.py"
+    )
+
+
+# ---------------------------------------------------------------------------
 # engine: catalog, suppression, baseline, CLI, repo self-check
 
 
 def test_catalog_covers_all_families():
-    assert len(RULE_CATALOG) >= 10
+    assert len(RULE_CATALOG) >= 20
     families = {code[:4] for code in RULE_CATALOG}
-    assert families == {"RTL1", "RTL2", "RTL3", "RTL4", "RTL5"}
+    assert families == {"RTL1", "RTL2", "RTL3", "RTL4", "RTL5", "RTL6", "RTL7"}
 
 
 def test_noqa_suppresses_specific_and_bare():
@@ -550,6 +1189,67 @@ def test_cli_exit_codes(tmp_path):
     )
     assert r.returncode == 0
     assert r.stdout == ""
+
+
+def test_cli_family_filter(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(params, v):\n    params['k'] = v\n")
+
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "relora_tpu.analysis",
+            "--no-baseline", "--family", "RTL5", str(bad),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO_ROOT),
+    )
+    assert r.returncode == 1
+    assert "RTL501" in r.stdout
+
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "relora_tpu.analysis",
+            "--no-baseline", "--family", "RTL6", str(bad),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO_ROOT),
+    )
+    assert r.returncode == 0
+
+
+def test_cli_call_graph_dump(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self._tick()
+
+                def _tick(self):
+                    pass
+            """
+        )
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "relora_tpu.analysis",
+            "--call-graph-dump", str(mod),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO_ROOT),
+    )
+    assert r.returncode == 0
+    assert "root[thread] W._loop" in r.stdout
+    assert "W._loop -> W._tick" in r.stdout
 
 
 def test_repo_lints_clean_against_baseline():
