@@ -915,6 +915,98 @@ def serve_load_main(router: bool = False) -> None:
             "dispatch": scheduler.dispatch_stats(),
             "levels": p_rows,
         }
+    # disaggregated handoff run (paged only): an in-process prefill-role ->
+    # decode-role scheduler pair drains the long+short mix through the real
+    # wire framing and compares against one mixed scheduler.  The numbers
+    # the gate reads are structural (token parity, drops, int8-vs-bf16
+    # migrated-bytes ratio — counts, not wall time), so the rule holds
+    # off-TPU too.
+    disagg_run = None
+    if paged and os.environ.get("BENCH_HTTP_DISAGG", "1") != "0":
+        from relora_tpu.serve import wire as _wire
+        from relora_tpu.serve.scheduler import Request as _Request
+
+        n_disagg = int(os.environ.get("BENCH_HTTP_DISAGG_REQUESTS", "24"))
+        disagg_threshold = (
+            (prompt_len + long_prompt_len) // 2 if long_share > 0 else prompt_len + 1
+        )
+        disagg_reqs = [
+            _Request(uid=i, prompt=pick_prompt(i), max_new_tokens=new_tokens)
+            for i in range(n_disagg)
+        ]
+
+        def disagg_drain(kv_dtype: str) -> dict:
+            num_pages = num_pages_env or (max_batch * (cache_size // page_size) + 1)
+            eng = InferenceEngine(
+                cfg, params, cache_size=cache_size,
+                page_size=page_size, num_pages=num_pages, chunk_size=chunk_size,
+                kv_dtype=kv_dtype,
+            )
+            eng.warmup(max_batch, migrate=True)
+            mk = lambda role: PagedContinuousBatchingScheduler(
+                eng, max_batch=max_batch, role=role, key=jax.random.PRNGKey(1)
+            )
+            t0 = time.perf_counter()
+            baseline = mk("mixed").run(disagg_reqs)
+            mixed_s = time.perf_counter() - t0
+            donor, recv = mk("prefill"), mk("decode")
+            completions, handoffs = {}, []
+            donor.migration_sink = lambda record, entries: handoffs.append(
+                (int(record["uid"]), _wire.encode_page_run(record, entries))
+            ) or True
+            finish = lambda c: completions.__setitem__(c.uid, c)
+            for req in disagg_reqs:
+                pool_sched = donor if len(req.prompt) >= disagg_threshold else recv
+                pool_sched.submit(req, on_finish=finish)
+            t0 = time.perf_counter()
+            # bounded: a wedged drain surfaces as dropped_requests, not a hang
+            for _ in range(64 * (n_disagg + 1) * (new_tokens + 1)):
+                if not (donor.has_work() or recv.has_work() or handoffs):
+                    break
+                if donor.has_work():
+                    donor.step()
+                waiting = []
+                for uid, blob in handoffs:
+                    try:
+                        record, arrays = _wire.decode_page_run(blob)
+                        recv.submit_migrated(record, arrays, on_finish=finish)
+                        donor.migration_commit(uid, len(blob))
+                    except RuntimeError:
+                        waiting.append((uid, blob))  # receiver full: wait
+                    except Exception as e:
+                        donor.migration_failed(uid, str(e))
+                handoffs[:] = waiting
+                if recv.has_work():
+                    recv.step()
+            disagg_s = time.perf_counter() - t0
+            parity = len(completions) == len(baseline) and all(
+                uid in completions and completions[uid].tokens == c.tokens
+                for uid, c in baseline.items()
+            )
+            return {
+                "kv_dtype": kv_dtype,
+                "requests": len(disagg_reqs),
+                "token_parity": parity,
+                "dropped_requests": len(baseline) - len(completions),
+                "migrated_inserts": recv._migrated_inserts,
+                "pages_migrated": donor._pages_migrated,
+                "migration_bytes": donor._migration_bytes,
+                "migration_failures": donor._migration_failures,
+                "mixed_drain_s": round(mixed_s, 3),
+                "disagg_drain_s": round(disagg_s, 3),
+            }
+
+        d_runs = {d: disagg_drain(d) for d in ("int8", "bf16")}
+        bf16_bytes = d_runs["bf16"]["migration_bytes"]
+        disagg_run = {
+            "classify_threshold": disagg_threshold,
+            "runs": d_runs,
+            "migrated_bytes_ratio_int8_vs_bf16": (
+                round(d_runs["int8"]["migration_bytes"] / bf16_bytes, 4)
+                if bf16_bytes
+                else None
+            ),
+        }
     # -- multi-tenant adapter sweep -------------------------------------------
     # Each count rebuilds the stack with a lora-enabled engine, an
     # AdapterRegistry preloaded with `count` tenants (distinct factor
@@ -1007,6 +1099,7 @@ def serve_load_main(router: bool = False) -> None:
                     "kv_dtype_runs": dtype_runs,
                     "spec_runs": spec_runs,
                     **({"packed_run": packed_run} if packed_run is not None else {}),
+                    **({"disagg_run": disagg_run} if disagg_run is not None else {}),
                 }
                 if paged
                 else {}

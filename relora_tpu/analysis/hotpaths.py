@@ -81,6 +81,27 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "PagedContinuousBatchingScheduler._prefill_pass",  # per round
         "ContinuousBatchingScheduler._acquire_adapter",  # per admitted request
         "ContinuousBatchingScheduler._release_adapter",  # per retired request
+        # disaggregation seams that run on the model thread, inside the
+        # round: export-and-park after a prefill finishes, adopt-and-resume
+        # on the receiver, peer prefix fetch during admission.  The async
+        # transfer itself (server._migrate_task and the /internal handlers)
+        # is event-loop code that never touches device values — deliberately
+        # NOT hot, same scoping as the rest of the HTTP front-end.
+        "PagedContinuousBatchingScheduler._maybe_migrate",
+        "PagedContinuousBatchingScheduler.submit_migrated",
+        "PagedContinuousBatchingScheduler._fetch_prefix",
+        "PagedContinuousBatchingScheduler.migration_commit",
+        "PagedContinuousBatchingScheduler.migration_failed",
+        "PagedContinuousBatchingScheduler.migration_abort",
+    ],
+    # role classification and the fleet prefix-page directory run per
+    # routed request / per collector scrape on threads adjacent to the
+    # serving plane; wire.py (framing) is transfer-cadence and stays cold
+    "relora_tpu/serve/disagg.py": [
+        "classify_request",
+        "PrefixPageDirectory.update",
+        "PrefixPageDirectory.lookup",
+        "pick_peers",
     ],
     # the HTTP front-end's model thread calls scheduler.step() in a loop; a
     # stray sync there stalls every in-flight stream.  The asyncio handlers
@@ -88,6 +109,7 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
     # deliberately NOT hot, so RTL2xx stays scoped to the decode loop.
     "relora_tpu/serve/server.py": [
         "GenerateServer._model_loop",
+        "GenerateServer._drain_disagg_inbox",  # runs inside _model_loop's round
     ],
     # the tracer/metrics/flight-recorder run INSIDE the hot loops above (a
     # few spans per decode step / train update) — stdlib-only by design;

@@ -149,11 +149,19 @@ def attend_with_paged_cache(
     cks = module.variable("cache", "k_scale", jnp.zeros, (num_pages, n_kv), jnp.float32)
     cvs = module.variable("cache", "v_scale", jnp.zeros, (num_pages, n_kv), jnp.float32)
     flat_rows = rows.reshape(-1)  # (B*T,)
+    # a tenant always enters a page at offset 0, so an offset-0 write starts
+    # that page's life: clear the previous tenant's scale (and, via ratio=0,
+    # its codes) instead of running-maxing into it.  Without this a recycled
+    # page quantizes its new tenant at whatever stale scale the old tenant
+    # left behind, making int8 decode depend on pool allocation history —
+    # greedy tokens would differ by batch composition.
+    fresh = jnp.where((offs == 0)[..., None], 0.0, 1.0)  # (B, T, 1)
 
     def write_quantized(codes, scales, new):
         new32 = new.astype(jnp.float32)
         # candidate per-token scale: absmax over head_dim -> (B, T, n_kv)
         cand = jnp.maximum(jnp.max(jnp.abs(new32), axis=-1) / 127.0, 1e-12)
+        scales = scales.at[rows].mul(fresh)  # recycled pages forget their past
         new_scale = scales.at[rows].max(cand)  # running max per (page, head)
         # requantize only the touched pages by old/new (1.0 when unchanged);
         # first-touch pages have old == 0 -> ratio 0, but their codes are 0

@@ -39,6 +39,7 @@ import time
 from typing import Any, Callable, Deque, Dict, Iterable, List, Mapping, Optional, Tuple
 
 from relora_tpu.obs.metrics import MetricsRegistry
+from relora_tpu.serve.disagg import PrefixPageDirectory
 from relora_tpu.utils.logging import get_logger
 
 __all__ = [
@@ -362,6 +363,11 @@ class FleetCollector:
         self.timeout_s = timeout_s
         self.jsonl_sources = dict(jsonl_sources or {})
         self.metrics = MetricsRegistry(namespace="relora_fleet")
+        # fleet-wide prefix-page directory (serve/disagg): fed from the
+        # prefix_digests list each replica advertises on /healthz, served to
+        # replicas via /fleet/prefix so a local PrefixCache miss becomes a
+        # peer fetch instead of a recompute
+        self.directory = PrefixPageDirectory()
         self._jsonl_offsets: Dict[str, int] = {}
         self._prev_counters: Dict[Tuple[str, str], Tuple[float, float]] = {}
         self._prev_hist_buckets: Dict[Tuple[str, str], Dict[float, float]] = {}
@@ -404,6 +410,11 @@ class FleetCollector:
                     for k, v in payload.items():
                         if isinstance(v, (int, float)) and not isinstance(v, bool):
                             values[f"healthz_{k}"] = float(v)
+                    digests = payload.get("prefix_digests")
+                    if code == 200 and isinstance(digests, list):
+                        self.directory.update(
+                            source, host, int(port), [str(d) for d in digests]
+                        )
                 except (json.JSONDecodeError, AttributeError):
                     status_str = str(code)
             except OSError:
@@ -415,6 +426,10 @@ class FleetCollector:
             except OSError:
                 self.metrics.inc("scrape_errors_total", ("source", source))
         values["up"] = up
+        if up < 1.0:
+            # a down replica's pages are unreachable; stale directory entries
+            # would send fetchers into connect timeouts until the next scrape
+            self.directory.drop_replica(source)
         prev_status = self._last_status.get(source)
         if prev_status is not None and prev_status != status_str:
             self.store.add_event(
@@ -433,6 +448,7 @@ class FleetCollector:
         spec_drafted = None
         spec_accepted = None
         evict_delta = None
+        mig_fail_delta = None
         disp_delta = None
         round_delta = None
         disp_tokens = None
@@ -461,6 +477,11 @@ class FleetCollector:
                 # see the whole run's evictions as one giant round
                 elif name.endswith("adapter_evictions_total"):
                     evict_delta = max(0.0, value - prev[1]) if prev is not None else 0.0
+                # KV-migration fail-open falls are a delta for the same
+                # reason: a rebuilt report must not replay lifetime failures
+                # as one round's incident
+                elif name.endswith("migration_failures_total"):
+                    mig_fail_delta = max(0.0, value - prev[1]) if prev is not None else 0.0
                 # packed-dispatch economics from counter deltas: how many
                 # model dispatches a scheduler round costs, and how much of
                 # each packed dispatch was real work vs bucket padding
@@ -507,6 +528,13 @@ class FleetCollector:
                     "adapter_thrash", source, t=now,
                     evictions=evict_delta, slots_used=slots_used,
                 )
+        if mig_fail_delta:
+            # every fall back to local decode is a typed event on the fleet
+            # timeline (docs/operations.md "migration_failed" runbook) — the
+            # request was served, but the disagg tier is leaking work
+            self.store.add_event(
+                "migration_failed", source, t=now, failures=mig_fail_delta
+            )
         for name, h in hists.items():
             # Quantiles of the *recent* distribution, from bucket deltas
             # between scrape rounds.  The exposition is cumulative over the
@@ -668,6 +696,20 @@ class FleetCollector:
                 last=int(last_s) if last_s and last_s.isdigit() else 256,
             )
             return 200, "application/json", json.dumps(payload).encode()
+        if parts.path == "/fleet/prefix":
+            q = parse_qs(parts.query)
+            raw = (q.get("d") or [""])[0]
+            digests = [d for d in raw.split(",") if d]
+            exclude = (q.get("exclude") or [None])[0]
+            hit = self.directory.lookup(digests, exclude_rid=exclude) if digests else None
+            if hit is None:
+                body = json.dumps({"error": "no holder known"}).encode()
+                return 404, "application/json", body
+            digest, rid, host, port = hit
+            body = json.dumps(
+                {"digest": digest, "replica": rid, "host": host, "port": port}
+            ).encode()
+            return 200, "application/json", body
         return None
 
 

@@ -90,13 +90,18 @@ class AdmissionController:
     #: Retry-After never exceeds this; a longer hint just loses the client.
     RETRY_AFTER_CAP_S = 30.0
 
-    def __init__(self, max_queue: int, *, retry_after_s: float = 1.0):
+    def __init__(
+        self, max_queue: int, *, retry_after_s: float = 1.0, uid_base: int = 0
+    ):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = max_queue
         self.retry_after_floor_s = retry_after_s
         self._q: "queue.Queue[Ticket]" = queue.Queue(maxsize=max_queue)
-        self._uids = itertools.count()
+        # uid_base makes fleet replicas' uid spaces disjoint: a migrated
+        # request keeps its donor uid (the sampling keys fold it in), so the
+        # receiver's own counter must never mint the same value
+        self._uids = itertools.count(uid_base)
         self._draining = threading.Event()
         self._tpot_ewma: Optional[float] = None  # model thread writes, any reads
 

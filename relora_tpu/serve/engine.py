@@ -56,6 +56,7 @@ from relora_tpu.core.relora import LoraSpec
 from relora_tpu.obs import memory as obs_memory
 from relora_tpu.obs.compile import CompileWatcher
 from relora_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, param_shardings
+from relora_tpu.serve.paging import NULL_PAGE
 from relora_tpu.serve.sampling import SamplingParams, sample
 
 PyTree = Any
@@ -145,6 +146,14 @@ def _reload_params_tree(params, fresh):
         else:
             out[key] = f
     return out
+
+
+def _pages_axis(ndim: int) -> int:
+    """Pages axis of a pool leaf: code leaves are ``(..., num_pages,
+    page_size, kv_heads, head_dim)`` (axis ndim-4), int8 scale leaves are
+    ``(..., num_pages, kv_heads)`` (axis ndim-2) — a leading layers axis
+    when scanned shifts both the same way."""
+    return ndim - 4 if ndim >= 4 else ndim - 2
 
 
 def build_decode_model(
@@ -465,6 +474,35 @@ class InferenceEngine:
 
             self._step_paged = cw.wrap(
                 "step_paged", jax.jit(step_paged_fn, donate_argnums=(3,))
+            )
+
+            # page-run migration seam (disaggregated prefill/decode): gather
+            # pulls a run of pool pages to host-bound slices, scatter writes
+            # a received run into freshly allocated pages.  Same shape
+            # discipline as the adapter writer: ids are bucketed (padded with
+            # the null page) so every steady-state transfer replays one of
+            # the warmed programs — zero retraces after a migrated insert.
+            def gather_pages_fn(pool, ids):
+                return jax.tree_util.tree_map(
+                    lambda leaf: jnp.take(leaf, ids, axis=_pages_axis(leaf.ndim)),
+                    pool,
+                )
+
+            def scatter_pages_fn(pool, ids, vals):
+                def put(leaf, val):
+                    axis = _pages_axis(leaf.ndim)
+                    out = jnp.moveaxis(leaf, axis, 0).at[ids].set(
+                        jnp.moveaxis(val, axis, 0)
+                    )
+                    return jnp.moveaxis(out, 0, axis)
+
+                return jax.tree_util.tree_map(put, pool, vals)
+
+            self._gather_pages = cw.wrap(
+                "page_gather", jax.jit(gather_pages_fn)
+            )
+            self._scatter_pages = cw.wrap(
+                "page_scatter", jax.jit(scatter_pages_fn, donate_argnums=(0,))
             )
 
     # -- cache construction --------------------------------------------------
@@ -912,6 +950,119 @@ class InferenceEngine:
             t = max(8, t // 2)
         return tuple(sorted(buckets))
 
+    def _warm_page_run(self, pool: PyTree) -> PyTree:
+        """Compile the migration gather/scatter pair at every page-run
+        bucket (null-page ids: reads/writes touch only the page nothing
+        attends).  Called inside warmup's ``expected_compiles`` block so a
+        migrated-slot insert at steady state is never a retrace."""
+        for nb in self.page_run_buckets():
+            ids = jnp.full((nb,), NULL_PAGE, jnp.int32)
+            vals = self._gather_pages(pool, ids)
+            pool = self._scatter_pages(pool, ids, vals)
+        return pool
+
+    def page_run_buckets(self) -> Tuple[int, ...]:
+        """Page-count shapes the migration gather/scatter compile for:
+        powers of two up to ``block_table_width`` (the widest run a single
+        request can own), plus the width itself.  Transfers pad their page
+        ids (with the null page) and payload (with zeros) up to the next
+        bucket, so steady-state migration replays warmed programs only."""
+        self._require_paged()
+        buckets: List[int] = []
+        t = 1
+        while t < self.block_table_width:
+            buckets.append(t)
+            t *= 2
+        buckets.append(self.block_table_width)
+        return tuple(buckets)
+
+    def _page_run_bucket(self, n: int) -> int:
+        for b in self.page_run_buckets():
+            if b >= n:
+                return b
+        raise ValueError(
+            f"page run of {n} pages exceeds block_table_width {self.block_table_width}"
+        )
+
+    def export_page_run(
+        self, pool: PyTree, pages: Sequence[int]
+    ) -> List[Tuple[str, str, Tuple[int, ...], bytes]]:
+        """Pull the pool slices for a page run to host bytes, ready for
+        :func:`wire.encode_page_run`.  One gather dispatch at the padded
+        bucket shape, then a host-side trim back to ``len(pages)`` — the
+        wire carries only real pages (int8 codes + their scales), the 4×
+        transfer win over a bf16 pool."""
+        self._require_paged()
+        n = len(pages)
+        if n < 1:
+            raise ValueError("empty page run")
+        bucket = self._page_run_bucket(n)
+        ids = list(pages) + [NULL_PAGE] * (bucket - n)
+        slices = self._gather_pages(pool, jnp.asarray(ids, jnp.int32))
+        flat, _ = jax.tree_util.tree_flatten_with_path(jax.device_get(slices))
+        out: List[Tuple[str, str, Tuple[int, ...], bytes]] = []
+        for path, leaf in flat:
+            arr = np.asarray(leaf)
+            arr = np.take(arr, range(n), axis=_pages_axis(arr.ndim))
+            out.append(
+                (jax.tree_util.keystr(path), str(arr.dtype), tuple(arr.shape),
+                 np.ascontiguousarray(arr).tobytes())
+            )
+        return out
+
+    def import_page_run(
+        self,
+        pool: PyTree,
+        pages: Sequence[int],
+        entries: Sequence[Tuple[str, str, Sequence[int], bytes]],
+    ) -> PyTree:
+        """Scatter a received page run into freshly allocated ``pages`` of
+        ``pool`` (donated).  Validates every entry against the engine's own
+        pool leaves — name set, dtype, and shape (with the pages axis equal
+        to ``len(pages)``) — and raises ValueError on any mismatch, so a
+        frame from a differently configured peer is rejected before a byte
+        lands in the pool.  Pads ids/payload up to the gather/scatter bucket
+        (pad writes land in the null page)."""
+        self._require_paged()
+        n = len(pages)
+        if n < 1:
+            raise ValueError("empty page run")
+        bucket = self._page_run_bucket(n)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.pool_shapes())
+        by_name = {jax.tree_util.keystr(p): leaf for p, leaf in flat}
+        got = {e[0]: e for e in entries}
+        if set(got) != set(by_name):
+            raise ValueError(
+                f"page-run leaves mismatch: got {sorted(got)}, want {sorted(by_name)}"
+            )
+        vals = []
+        for path, spec in flat:
+            name = jax.tree_util.keystr(path)
+            _, dtype, shape, raw = got[name]
+            axis = _pages_axis(spec.ndim)
+            want = list(spec.shape)
+            want[axis] = n
+            if str(dtype) != str(spec.dtype) or list(shape) != want:
+                raise ValueError(
+                    f"page-run leaf {name!r}: got {dtype}{list(shape)}, "
+                    f"want {spec.dtype}{want}"
+                )
+            arr = np.frombuffer(raw, dtype=np.dtype(str(dtype)))
+            if arr.size != int(np.prod(shape)):
+                raise ValueError(f"page-run leaf {name!r}: payload size mismatch")
+            arr = arr.reshape(shape)
+            if bucket > n:
+                pad = [(0, 0)] * arr.ndim
+                pad[axis] = (0, bucket - n)
+                arr = np.pad(arr, pad)
+            vals.append(arr)
+        ids = list(pages) + [NULL_PAGE] * (bucket - n)
+        return self._scatter_pages(
+            pool,
+            jnp.asarray(ids, jnp.int32),
+            jax.tree_util.tree_unflatten(treedef, vals),
+        )
+
     def default_prompt_buckets(self) -> Tuple[int, ...]:
         """Every prefill shape a prompt can actually land in: powers of two
         from the bucket minimum up, capped at ``cache_size`` (which is
@@ -931,6 +1082,7 @@ class InferenceEngine:
         *,
         prompt_buckets: Optional[Sequence[int]] = None,
         packed: bool = False,
+        migrate: bool = False,
     ) -> dict:
         """Compile the serving step functions before traffic arrives.
         An online server calls this at startup so the first real request
@@ -973,11 +1125,15 @@ class InferenceEngine:
                     self.write_adapter_slot(
                         self.adapter_slots - 1, self._factor_template, 0.0
                     )
+                if migrate:
+                    pool = self._warm_page_run(pool)
                 jax.block_until_ready(logits)
             events = cw.compile_events()[n_before:]
             shapes: dict = {"step_paged": [[1, Tb] for Tb in buckets]}
             if self.adapter_slots:
                 shapes["adapter_write"] = [self.adapter_slots]
+            if migrate:
+                shapes["page_run"] = list(self.page_run_buckets())
             return {
                 "batch": batch,
                 "prompt_buckets": [],
@@ -1022,6 +1178,8 @@ class InferenceEngine:
                     self.write_adapter_slot(
                         self.adapter_slots - 1, self._factor_template, 0.0
                     )
+                if migrate:
+                    pool = self._warm_page_run(pool)
                 jax.block_until_ready(logits)
             events = cw.compile_events()[n_before:]
             shapes = {
@@ -1032,6 +1190,8 @@ class InferenceEngine:
                 shapes["verify_paged"] = [batch, self.spec_k + 1]
             if self.adapter_slots:
                 shapes["adapter_write"] = [self.adapter_slots]
+            if migrate:
+                shapes["page_run"] = list(self.page_run_buckets())
             return {
                 "batch": batch,
                 "prompt_buckets": [],

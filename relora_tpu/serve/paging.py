@@ -220,6 +220,34 @@ class PrefixCache:
                 self._drop_lru()
         return created
 
+    def digests(self, limit: int = 64) -> List[str]:
+        """Hex digests of the most-recently-used entries, MRU first — the
+        replica advertises these on /healthz for the fleet prefix-page
+        directory (bounded so the payload stays scrape-sized)."""
+        out: List[str] = []
+        for digest in reversed(self._entries):
+            out.append(digest.hex())
+            if len(out) >= limit:
+                break
+        return out
+
+    def acquire(self, digest_hex: str) -> Optional[Tuple[List[int], int]]:
+        """Pin an entry's pages for an in-flight export: increfs every page
+        and returns ``(pages, n_tokens)``, or None when the digest is not
+        cached.  The caller must ``allocator.decref(pages)`` once the
+        transfer completes — the pin is what keeps LRU eviction (or a
+        concurrent ``clear``) from freeing a run mid-transfer."""
+        try:
+            digest = bytes.fromhex(digest_hex)
+        except ValueError:
+            return None
+        entry = self._entries.get(digest)
+        if entry is None:
+            return None
+        self._entries.move_to_end(digest)
+        self.allocator.incref(entry.pages)
+        return list(entry.pages), entry.n_tokens
+
     def evict(self, pages_wanted: int) -> int:
         """Drop LRU entries until the allocator has ``pages_wanted`` free
         pages or the cache is empty.  Returns pages actually freed."""

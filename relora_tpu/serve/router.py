@@ -71,6 +71,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Set, 
 
 from relora_tpu.obs.metrics import MetricsRegistry
 from relora_tpu.obs.tracer import Tracer, new_trace_id
+from relora_tpu.serve import disagg
 from relora_tpu.serve.wire import (
     MAX_BODY_BYTES,
     REASONS,
@@ -256,6 +257,7 @@ class Router:
         cooldown_max_s: float = 30.0,
         tracer: Optional[Tracer] = None,
         extra_routes: Optional[Callable[[str], Optional[Tuple[int, str, bytes]]]] = None,
+        classify_threshold: Optional[int] = None,
     ):
         self._endpoints = self._normalize_endpoints(endpoints)
         self.host = host
@@ -289,6 +291,14 @@ class Router:
         # e.g. the supervisor's FleetCollector mounting /fleet/* on this
         # front-end: path -> (status, content_type, body) or None = 404
         self._extra_routes = extra_routes
+        # disaggregated fleet: classify requests by prompt length into the
+        # prefill vs decode replica pools (replica roles come from healthz);
+        # None = role-blind routing, the pre-disagg behaviour
+        self.classify_threshold = classify_threshold
+        if classify_threshold is not None:
+            self.stats.inc("routed_prefill_total", by=0)
+            self.stats.inc("routed_decode_total", by=0)
+            self.stats.inc("route_fallback_total", by=0)
         self.replicas: Dict[str, ReplicaState] = {}
         self.started = threading.Event()
         self._t_start = time.monotonic()
@@ -466,7 +476,10 @@ class Router:
     # -- selection -----------------------------------------------------------
 
     def _pick(
-        self, exclude: Set[str], adapter: Optional[str] = None
+        self,
+        exclude: Set[str],
+        adapter: Optional[str] = None,
+        role: Optional[str] = None,
     ) -> Optional[ReplicaState]:
         # a group is routable only when every shard is healthy; requests go
         # to its primary (lowest rid), scored by the whole group's load
@@ -496,6 +509,24 @@ class Router:
                     return st
                 break
             self.stats.inc("affinity_fallback_total")
+        if role is not None and candidates:
+            # role routing: prefer the request's pool (replica roles come
+            # from healthz), then mixed replicas, then — degraded fleet —
+            # anyone routable; each widening is a counted fallback
+            pool = [
+                (st, load)
+                for st, load in candidates
+                if str(st.health.get("role", "mixed")) == role
+            ]
+            if not pool:
+                pool = [
+                    (st, load)
+                    for st, load in candidates
+                    if str(st.health.get("role", "mixed")) == "mixed"
+                ]
+                self.stats.inc("route_fallback_total")
+            if pool:
+                candidates = pool
         ready = [(st, load) for st, load in candidates if st.breaker.state == "closed"]
         if not ready:
             # no closed circuit: offer half-open trials (allow() mutates)
@@ -614,23 +645,36 @@ class Router:
         headers: Dict[str, str],
     ) -> None:
         rid_hdr = (headers.get("x-request-id") or "").strip() or new_trace_id()
-        # tenant affinity key: a parse failure routes anywhere and the
-        # replica's own body validation answers the 400
+        # tenant affinity key + route class: a parse failure routes anywhere
+        # and the replica's own body validation answers the 400
         adapter: Optional[str] = None
+        role: Optional[str] = None
         try:
             payload = json.loads(body.decode("utf-8") or "{}")
-            name = payload.get("adapter") if isinstance(payload, dict) else None
-            if isinstance(name, str) and name.strip():
-                adapter = name.strip()
+            if isinstance(payload, dict):
+                name = payload.get("adapter")
+                if isinstance(name, str) and name.strip():
+                    adapter = name.strip()
+                if self.classify_threshold is not None:
+                    prompt = payload.get("prompt")
+                    role = disagg.classify_request(
+                        len(prompt) if isinstance(prompt, list) else 0,
+                        self.classify_threshold,
+                    )
+                    self.stats.inc(f"routed_{role}_total")
         except (UnicodeDecodeError, json.JSONDecodeError):
             pass
         # root span of this process's share of the request: trace_id is the
         # request id, the same id the replica uses for its own spans, so the
         # merged trace (tools/trace_report.py) shows router -> replica ->
         # model thread as one tree
-        root = self.tracer.start_span("route", trace_id=rid_hdr, adapter=adapter)
+        root = self.tracer.start_span(
+            "route", trace_id=rid_hdr, adapter=adapter, route_class=role
+        )
         try:
-            outcome = await self._proxy_attempts(writer, body, rid_hdr, root, adapter)
+            outcome = await self._proxy_attempts(
+                writer, body, rid_hdr, root, adapter, role
+            )
         finally:
             root.set(outcome=outcome if isinstance(outcome, str) else "error").end()
 
@@ -641,6 +685,7 @@ class Router:
         rid_hdr: str,
         root,
         adapter: Optional[str] = None,
+        role: Optional[str] = None,
     ) -> str:
         # shared across attempts: once any SSE body byte reaches the client,
         # the request is no longer retryable (the idempotency boundary)
@@ -649,7 +694,7 @@ class Router:
         backoff = self.retry_backoff_s
         passthrough: Optional[Tuple[int, Dict[str, str], bytes]] = None
         for attempt in range(self.max_attempts):
-            st = self._pick(exclude=set(tried), adapter=adapter)
+            st = self._pick(exclude=set(tried), adapter=adapter, role=role)
             if st is None:
                 break
             tried.append(st.rid)

@@ -56,9 +56,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from relora_tpu.obs.tracer import NoopTracer
+from relora_tpu.serve import wire
 from relora_tpu.serve.engine import InferenceEngine, bucket_length
 from relora_tpu.serve.paging import PageAllocator, PrefixCache, pages_needed
 from relora_tpu.serve.sampling import SamplingParams, spec_verify_draws
+from relora_tpu.utils import faults
 from relora_tpu.utils.logging import MetricsLogger, get_logger
 
 logger = get_logger(__name__)
@@ -652,6 +654,7 @@ class _PagedSlot(_Slot):
     prefill_progress: int = 0  # prompt tokens already written to the pool
     decoding: bool = False  # first token sampled; joins the decode batch
     seq: int = 0  # admission order; chunk scheduling is oldest-first
+    migrating: bool = False  # handoff to a decode-pool peer is in flight
 
 
 class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
@@ -722,9 +725,14 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         prefix_cache_entries: int = 256,
         spec: str = "off",
         packed: bool = False,
+        role: str = "mixed",
         **kwargs,
     ):
         super().__init__(engine, **kwargs)
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or 'mixed', got {role!r}"
+            )
         if spec not in ("off", "ngram"):
             raise ValueError(f"spec must be 'off' or 'ngram', got {spec!r}")
         if spec != "off" and getattr(engine, "spec_k", 0) < 1:
@@ -797,6 +805,22 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         # serve/kv_cache_bytes and serve/kv_bytes_per_token gauges
         self._kv_cache_bytes = engine.pool_bytes()
         self._kv_bytes_per_token = engine.kv_bytes_per_token()
+        # disaggregated serving (docs/serving.md): a prefill-role scheduler
+        # hands each finished prompt's page run to ``migration_sink`` (set by
+        # the server; runs on the model thread, must not block) and parks the
+        # slot as ``migrating`` until the peer commits or the handoff fails
+        # open back to local decode.  ``prefix_fetch`` pulls prefix pages
+        # from a peer on a local cache miss (the fleet prefix directory).
+        self.role = role
+        self.migration_sink: Optional[Callable[[Dict[str, Any], list], bool]] = None
+        self.prefix_fetch: Optional[Callable[[List[str]], Any]] = None
+        self._prefix_fetch_tried: set = set()
+        self._pages_migrated = 0
+        self._migration_bytes = 0
+        self._migration_failures = 0
+        self._migrated_inserts = 0
+        self._prefix_fetches = 0
+        self._prefix_fetch_failures = 0
 
     # -- admission ------------------------------------------------------------
 
@@ -842,6 +866,17 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             shared_tokens = 0
             if self.prefix_cache is not None:
                 shared_pages, shared_tokens = self.prefix_cache.lookup(req.prompt)
+                if (
+                    not shared_pages
+                    and self.prefix_fetch is not None
+                    and req.uid not in self._prefix_fetch_tried
+                ):
+                    # one fetch attempt per uid: a miss (or a failed peer)
+                    # falls open to local prefill, never a retry loop
+                    if len(self._prefix_fetch_tried) > 8192:
+                        self._prefix_fetch_tried.clear()
+                    self._prefix_fetch_tried.add(req.uid)
+                    shared_pages, shared_tokens = self._fetch_prefix(req)
             fresh = self.allocator.alloc(need - len(shared_pages))
             if fresh is None and self.prefix_cache is not None:
                 # under pressure: drop idle prefix entries (LRU) and retry —
@@ -892,7 +927,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         prefilling = [
             (s.seq, i)
             for i, s in enumerate(self._slots)
-            if s is not None and not s.decoding
+            if s is not None and not s.decoding and not s.migrating
         ]
         if not prefilling:
             return
@@ -947,6 +982,292 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         self._tables[slot_idx, : len(slot.pages)] = slot.pages
         self._emit_token(req.uid, first_id, 0)
         self._finish_if_done(slot_idx, finished)
+        self._maybe_migrate(slot_idx)
+
+    # -- disaggregated handoff (prefill role -> decode peer) --------------------
+
+    def _find_slot(self, uid: int) -> Optional[int]:
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is not None and slot.request.uid == uid:
+                return slot_idx
+        return None
+
+    def _maybe_migrate(self, slot_idx: int) -> None:
+        """Donor side: a prefill-role scheduler that just completed a prompt
+        exports its filled page run and hands ``(record, entries)`` to the
+        server's migration sink.  The slot parks as ``migrating`` — out of
+        both the prefill and decode sets — until ``migration_commit`` /
+        ``migration_abort`` / ``migration_failed`` resolves it.  Any export
+        or sink error fails open: the slot resumes decoding locally."""
+        if self.role != "prefill" or self.migration_sink is None:
+            return
+        slot = self._slots[slot_idx]
+        if slot is None or slot.migrating or not slot.decoding:
+            return  # finished at prefill (eos / max_new_tokens == 1)
+        req = slot.request
+        n_pages = pages_needed(len(req.prompt), self.engine.page_size)
+        try:
+            faults.maybe_fail("serve_migrate")
+            entries = self.engine.export_page_run(
+                self._ensure_pool(), slot.pages[:n_pages]
+            )
+        except Exception as e:
+            logger.warning(f"request {req.uid}: page-run export failed: {e!r}")
+            self._count_migration_failure(req.uid, f"export failed: {e}")
+            return  # slot keeps decoding locally, untouched
+        record = wire.build_migration_record(
+            uid=req.uid,
+            prompt=req.prompt,
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            spec=req.spec,
+            adapter=req.adapter,
+            first_token=slot.tokens[0],
+            position=slot.pos,
+            token_index=len(slot.tokens),
+            n_pages=n_pages,
+        )
+        # park: the decode row goes back to the null table so this round's
+        # (and every later round's) garbage write lands in the null page
+        slot.migrating = True
+        slot.decoding = False
+        self._tokens[slot_idx] = 0
+        self._positions[slot_idx] = 0
+        self._tables[slot_idx, :] = 0
+        ok = False
+        try:
+            ok = bool(self.migration_sink(record, entries))
+        except Exception as e:
+            logger.warning(f"request {req.uid}: migration sink failed: {e!r}")
+        if not ok:
+            self.migration_failed(req.uid, "sink rejected handoff")
+
+    def migration_failed(self, uid: int, detail: Optional[str] = None) -> None:
+        """Fail open: the handoff died before the peer relayed any token —
+        resume decoding locally from exactly where prefill left off.  The
+        client stream never notices (same sampling keys, same token
+        indices); the failure is a typed counter + event, not an error."""
+        slot_idx = self._find_slot(uid)
+        if slot_idx is None:
+            return  # cancelled/expired while the transfer was in flight
+        slot = self._slots[slot_idx]
+        if not slot.migrating:
+            return
+        slot.migrating = False
+        slot.decoding = True
+        self._tokens[slot_idx] = slot.tokens[-1]
+        self._positions[slot_idx] = slot.pos
+        self._tables[slot_idx, : len(slot.pages)] = slot.pages
+        self._count_migration_failure(uid, detail)
+
+    def _count_migration_failure(self, uid: int, detail: Optional[str]) -> None:
+        self._migration_failures += 1
+        logger.warning(
+            f"request {uid}: migration failed open to local decode"
+            + (f" ({detail})" if detail else "")
+        )
+        if self.obs_registry is not None:
+            self.obs_registry.inc("migration_failures_total")
+
+    def migration_commit(self, uid: int, bytes_sent: int = 0) -> Optional[Completion]:
+        """The decode peer accepted the run and the relay delivered the
+        peer's finish: retire the donor slot WITHOUT firing the client
+        callbacks (the relay already owns that stream) and free its pages."""
+        slot_idx = self._find_slot(uid)
+        if slot_idx is None:
+            return None
+        slot = self._slots[slot_idx]
+        if not slot.migrating:
+            return None
+        self._on_token.pop(uid, None)
+        self._on_finish.pop(uid, None)
+        n_pages = pages_needed(len(slot.request.prompt), self.engine.page_size)
+        self._pages_migrated += n_pages
+        self._migration_bytes += bytes_sent
+        if self.obs_registry is not None:
+            self.obs_registry.inc("pages_migrated_total", by=n_pages)
+            self.obs_registry.inc("migration_bytes_total", by=bytes_sent)
+        return self._retire(slot_idx, "migrated")
+
+    def migration_abort(self, uid: int, detail: Optional[str] = None) -> Optional[Completion]:
+        """The peer died AFTER relaying at least one token: the request
+        cannot be silently replayed (PR 9 idempotency boundary), so the
+        server sends the client a typed error finish and this retires the
+        donor slot without firing the (already-detached) callbacks."""
+        slot_idx = self._find_slot(uid)
+        if slot_idx is None:
+            return None
+        slot = self._slots[slot_idx]
+        if not slot.migrating:
+            return None
+        self._on_token.pop(uid, None)
+        self._on_finish.pop(uid, None)
+        self._count_migration_failure(uid, detail or "peer died mid-relay")
+        return self._retire(slot_idx, "error", detail or "migration_failed")
+
+    def submit_migrated(
+        self,
+        record: Dict[str, Any],
+        entries: Sequence,
+        *,
+        on_token: Optional[TokenCallback] = None,
+        on_finish: Optional[FinishCallback] = None,
+        deadline: Optional[float] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        """Receiver side: install a migrated request straight into a decode
+        slot — scatter its page run into freshly allocated pages, arm the
+        decode row at the donor's position, and continue sampling with keys
+        ``(uid, token_index)`` unchanged, so the drain is token-identical to
+        a mixed replica.  Raises on ANY precondition miss (dup uid, no free
+        slot, no adapter capacity, pool exhausted, malformed run) — the
+        donor maps a raise to fail-open local decode, so rejecting here is
+        always safe.  Runs on the model thread, like every mutator."""
+        fields = wire.parse_migration_record(record)
+        req = Request(
+            uid=fields["uid"],
+            prompt=fields["prompt"],
+            max_new_tokens=fields["max_new_tokens"],
+            temperature=fields["temperature"],
+            top_p=fields["top_p"],
+            spec=fields["spec"],
+            adapter=fields["adapter"],
+        )
+        self.validate_request(req)
+        if req.uid in self._deadlines or req.uid in self._on_finish or any(
+            r.uid == req.uid for r in self._pending
+        ) or any(s is not None and s.request.uid == req.uid for s in self._slots):
+            raise ValueError(f"migrated request {req.uid}: uid already in flight")
+        L = len(req.prompt)
+        n_pages = fields["n_pages"]
+        if fields["position"] != L or n_pages != pages_needed(
+            L, self.engine.page_size
+        ):
+            raise ValueError(
+                f"migrated request {req.uid}: inconsistent run "
+                f"(position {record['position']}, n_pages {n_pages}, prompt {L})"
+            )
+        slot_idx = next(
+            (i for i in range(self.max_batch) if self._slots[i] is None), None
+        )
+        if slot_idx is None:
+            raise RuntimeError(f"migrated request {req.uid}: no free slot")
+        adapter_slot = self._acquire_adapter(req)
+        if adapter_slot is None:
+            raise RuntimeError(f"migrated request {req.uid}: no adapter capacity")
+        try:
+            need = pages_needed(L + req.max_new_tokens, self.engine.page_size)
+            pages = self.allocator.alloc(need)
+            if pages is None and self.prefix_cache is not None:
+                self.prefix_cache.evict(need)
+                pages = self.allocator.alloc(need)
+            if pages is None:
+                raise RuntimeError(f"migrated request {req.uid}: pool exhausted")
+            try:
+                self._pool = self.engine.import_page_run(
+                    self._ensure_pool(), pages[:n_pages], entries
+                )
+            except Exception:
+                self.allocator.decref(pages)
+                raise
+        except Exception:
+            self._release_adapter(req)
+            raise
+        first = fields["first_token"]
+        now = time.monotonic()
+        self._slots[slot_idx] = _PagedSlot(
+            request=req,
+            pos=L,
+            tokens=[first],
+            t_admit=now,
+            t_first=now,
+            deadline=deadline,
+            span=self.tracer.start_span("decode", trace_id=trace_id, uid=req.uid),
+            pages=pages,
+            shared_pages=0,
+            prefill_progress=L,
+            decoding=True,
+            seq=self._admit_seq,
+            adapter_slot=adapter_slot,
+        )
+        self._admit_seq += 1
+        if deadline is not None:
+            self._deadlines[req.uid] = deadline
+        if on_token is not None:
+            self._on_token[req.uid] = on_token
+        if on_finish is not None:
+            self._on_finish[req.uid] = on_finish
+        if trace_id is not None:
+            self._trace_ids[req.uid] = trace_id
+        self._tokens[slot_idx] = first
+        self._positions[slot_idx] = L
+        self._tables[slot_idx, :] = 0
+        self._tables[slot_idx, : len(pages)] = pages
+        self._ptables[slot_idx, :] = 0
+        self._ptables[slot_idx, : len(pages)] = pages
+        self._adapter_row[slot_idx] = adapter_slot
+        if self.prefix_cache is not None:
+            # the migrated prompt's pages are as shareable as a locally
+            # prefilled one's — register them for later local hits
+            self.prefix_cache.register(list(req.prompt), pages)
+        self._migrated_inserts += 1
+        if self.obs_registry is not None:
+            self.obs_registry.inc("migrated_inserts_total")
+
+    def _fetch_prefix(self, req: Request) -> tuple:
+        """Fleet prefix-page directory client path: on a local miss, ask the
+        directory for the longest cached page-aligned prefix of ``req``'s
+        prompt held by a peer, import its pages, register them locally, and
+        re-run the local lookup.  Every failure path returns ``([], 0)`` —
+        fail open to local prefill."""
+        ps = self.engine.page_size
+        k_max = (len(req.prompt) - 1) // ps
+        if k_max < 1 or self.prefix_cache is None:
+            return [], 0
+        digests = [
+            PrefixCache._digest(req.prompt[: k * ps]).hex()
+            for k in range(k_max, 0, -1)
+        ]
+        try:
+            faults.maybe_fail("serve_prefix_fetch")
+            hit = self.prefix_fetch(digests)
+            if hit is None:
+                return [], 0
+            n_tokens, entries, nbytes = hit
+            n_tokens = int(n_tokens)
+            if n_tokens < ps or n_tokens % ps or n_tokens > k_max * ps:
+                raise ValueError(f"peer returned unusable prefix ({n_tokens} tokens)")
+            n_pages = n_tokens // ps
+            pages = self.allocator.alloc(n_pages)
+            if pages is None:
+                self.prefix_cache.evict(n_pages)
+                pages = self.allocator.alloc(n_pages)
+            if pages is None:
+                return [], 0  # pool pressure: not a failure, just skip
+            try:
+                self._pool = self.engine.import_page_run(
+                    self._ensure_pool(), pages, entries
+                )
+            except Exception:
+                self.allocator.decref(pages)
+                raise
+            self.prefix_cache.register(list(req.prompt[:n_tokens]), pages)
+            # the cache's own refs keep the run alive; drop the alloc ref and
+            # let the re-lookup incref for this request like any local hit
+            self.allocator.decref(pages)
+            self._prefix_fetches += 1
+            self._migration_bytes += int(nbytes)
+            if self.obs_registry is not None:
+                self.obs_registry.inc("prefix_fetch_total")
+                self.obs_registry.inc("migration_bytes_total", by=int(nbytes))
+            return self.prefix_cache.lookup(req.prompt)
+        except Exception as e:
+            logger.warning(f"request {req.uid}: prefix fetch failed: {e!r}")
+            self._prefix_fetch_failures += 1
+            if self.obs_registry is not None:
+                self.obs_registry.inc("prefix_fetch_failures_total")
+            return [], 0
 
     # -- speculative draft / verify --------------------------------------------
 
@@ -1116,6 +1437,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             if self._dispatch_total > d0:
                 self._count_round()  # pure-prefill round still dispatched
                 self._admit_time_s += admit_s  # a 100%-stall round
+            elif any(s is not None and s.migrating for s in self._slots):
+                time.sleep(0.001)  # only parked handoffs: don't hot-spin
             return finished  # pure-prefill round (or idle)
 
         t_decode = time.monotonic()
@@ -1215,6 +1538,12 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             self.obs_registry.inc("sched_rounds_total", by=0)
             self.obs_registry.inc("dispatch_tokens_total", by=0)
             self.obs_registry.inc("dispatch_tokens_real_total", by=0)
+            self.obs_registry.inc("pages_migrated_total", by=0)
+            self.obs_registry.inc("migration_bytes_total", by=0)
+            self.obs_registry.inc("migration_failures_total", by=0)
+            self.obs_registry.inc("migrated_inserts_total", by=0)
+            self.obs_registry.inc("prefix_fetch_total", by=0)
+            self.obs_registry.inc("prefix_fetch_failures_total", by=0)
             if self._spec != "off":
                 self.obs_registry.set_gauge(
                     "spec_accept_rate",
@@ -1328,7 +1657,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         for _, slot_idx in sorted(
             (s.seq, i)
             for i, s in enumerate(self._slots)
-            if s is not None and not s.decoding
+            if s is not None and not s.decoding and not s.migrating
         ):
             if budget_left <= 0:
                 break
@@ -1347,6 +1676,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
 
         n_real = len(ids)
         if n_real == 0:
+            if any(s is not None and s.migrating for s in self._slots):
+                time.sleep(0.001)  # only parked handoffs: don't hot-spin
             return finished  # nothing decodable and nothing left to prefill
         bucket = next(b for b in engine.packed_buckets() if b >= n_real)
         pad = bucket - n_real
@@ -1457,6 +1788,7 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 self._tables[slot_idx, : len(slot.pages)] = slot.pages
                 self._emit_token(req.uid, first_id, 0)
                 self._finish_if_done(slot_idx, finished)
+                self._maybe_migrate(slot_idx)
         decode_s = time.monotonic() - t_decode
         self._observe("decode_step_seconds", decode_s)
         # dispatch and round tick together at round end: a concurrent
@@ -1505,7 +1837,22 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         if self._spec != "off":
             stats["spec"] = self.spec_stats()
         stats["dispatch"] = self.dispatch_stats()
+        stats["disagg"] = self.disagg_stats()
         return stats
+
+    def disagg_stats(self) -> Dict[str, Any]:
+        """Cumulative disaggregation counters — the /healthz ``disagg``
+        block (role + migration/prefix-fetch economics) bench.py and the
+        smoke drill read."""
+        return {
+            "role": self.role,
+            "pages_migrated": self._pages_migrated,
+            "migration_bytes": self._migration_bytes,
+            "migration_failures": self._migration_failures,
+            "migrated_inserts": self._migrated_inserts,
+            "prefix_fetches": self._prefix_fetches,
+            "prefix_fetch_failures": self._prefix_fetch_failures,
+        }
 
     def dispatch_stats(self) -> Dict[str, Any]:
         """Cumulative dispatch-economics counters — the /healthz

@@ -52,10 +52,14 @@ import os
 import signal
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Set, Tuple
+import zlib
+from collections import deque
+from typing import Any, Callable, Deque, Dict, Optional, Set, Tuple
+from urllib.parse import urlsplit
 
 from relora_tpu.obs.flight import dump_on_fault
 from relora_tpu.obs.tracer import NoopTracer, Tracer, new_trace_id
+from relora_tpu.serve import disagg
 from relora_tpu.serve.admission import (
     AdmissionController,
     Draining,
@@ -69,6 +73,8 @@ from relora_tpu.serve.scheduler import (
     Request,
 )
 from relora_tpu.serve.wire import (
+    decode_page_run as _decode_page_run,
+    encode_page_run as _encode_page_run,
     head as _head,
     read_http_request as _read_http_request,
     respond as _respond,
@@ -210,11 +216,25 @@ class GenerateServer:
         weights_version: int = 0,
         weights_checkpoint: str = "",
         warmup_fn: Optional[Callable[[], Any]] = None,
+        peer_file: Optional[str] = None,
+        fleet_url: Optional[str] = None,
+        migrate_timeout_s: float = 30.0,
     ):
         self.scheduler = scheduler
         self.host = host
         self.port = port  # rebound to the real port after bind (port=0 = ephemeral)
-        self.admission = AdmissionController(max_queue, retry_after_s=retry_after_s)
+        # disaggregated fleet identity: replicas carry disjoint uid spaces so
+        # a migrated request's donor uid (folded into its sampling keys, so
+        # it must travel unchanged) can never collide with a local mint
+        self.replica_id = os.environ.get("RELORA_TPU_REPLICA_ID", f"pid{os.getpid()}")
+        uid_base = (
+            (zlib.crc32(self.replica_id.encode()) % 1021 + 1) << 21
+            if "RELORA_TPU_REPLICA_ID" in os.environ
+            else 0
+        )
+        self.admission = AdmissionController(
+            max_queue, retry_after_s=retry_after_s, uid_base=uid_base
+        )
         self.stats = ServeMetrics()
         self.metrics = metrics
         if tracer is None:
@@ -282,7 +302,10 @@ class GenerateServer:
         # health probes observe the 503 "error" state (a router ejects on
         # status, not just connection-refused) before the process exits
         self.error_linger_s = error_linger_s
-        self._tokens_emitted = 0  # model thread only; feeds faults.serve_tick
+        # feeds faults.serve_tick; incremented from the model thread (local
+        # decode) AND the event loop (migration-relay streams), so locked
+        self._tokens_emitted = 0
+        self._emitted_lock = threading.Lock()
         # -- in-place weight reload (continuous deployment) --------------------
         # reload_prepare(path) runs off the model thread (verify manifest +
         # restore to host memory) and returns the apply closure the model
@@ -310,6 +333,35 @@ class GenerateServer:
         self.warmup_report: Optional[Any] = None
         self._warming = warmup_fn is not None
         self.stats.set_gauge("warming", 1 if self._warming else 0)
+        # -- disaggregated prefill/decode tier ---------------------------------
+        # role comes from the scheduler (serve.py --role); peer_file is the
+        # supervisor-maintained roster; fleet_url reaches the collector's
+        # /fleet/prefix directory.  The inbox carries cross-thread work INTO
+        # the model thread (handoff outcomes, migrated-run inserts, prefix
+        # exports) — drained once per model-loop iteration, the same
+        # idle-boundary discipline as _ReloadRequest.
+        self.role = getattr(scheduler, "role", "mixed")
+        self.peer_file = peer_file
+        self.fleet_url = fleet_url
+        self.migrate_timeout_s = migrate_timeout_s
+        self._disagg_inbox: Deque[Tuple[str, Any]] = deque()
+        if hasattr(scheduler, "migration_sink"):
+            if self.role == "prefill" and peer_file:
+                scheduler.migration_sink = self._migration_sink
+            if fleet_url:
+                scheduler.prefix_fetch = self._prefix_fetch
+            # materialize the disagg counters at zero at startup (RTL703 +
+            # the collector's *_per_s derivations need the series from the
+            # very first scrape, not the first migration)
+            for name in (
+                "pages_migrated_total",
+                "migration_bytes_total",
+                "migration_failures_total",
+                "migrated_inserts_total",
+                "prefix_fetch_total",
+                "prefix_fetch_failures_total",
+            ):
+                self.stats.inc(name, by=0)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -416,6 +468,7 @@ class GenerateServer:
                 for uid, ticket in list(self._active.items()):
                     if ticket.cancelled.is_set():
                         sched.cancel(uid)  # fires on_finish -> _active cleanup
+                self._drain_disagg_inbox()
                 self.stats.set_gauge(
                     "queue_depth", self.admission.depth() + sched.queue_depth
                 )
@@ -625,40 +678,377 @@ class GenerateServer:
             )
             return
         self._active[ticket.uid] = ticket
-
-        def on_token(uid: int, token: int, index: int, _t: Ticket = ticket) -> None:
-            now = time.monotonic()
-            if index == 0:
-                self.stats.observe("ttft_seconds", now - _t.t_enqueue)
-            elif _t.t_last_token is not None:
-                tpot = now - _t.t_last_token
-                self.stats.observe("tpot_seconds", tpot)
-                self.admission.note_tpot(tpot)  # feeds the Retry-After hint
-            _t.t_last_token = now
-            self._tokens_emitted += 1
-            self.stats.inc("tokens_generated_total")
-            _t.on_token(uid, token, index)
-
-        def on_finish(completion: Completion, _t: Ticket = ticket) -> None:
-            self._active.pop(completion.uid, None)
-            self.stats.inc("requests_finished_total", ("reason", completion.finish_reason))
-            self.stats.observe(
-                "e2e_latency_seconds", time.monotonic() - _t.t_enqueue
-            )
-            if _t.span is not None:
-                _t.span.set(
-                    finish_reason=completion.finish_reason,
-                    output_tokens=len(completion.tokens),
-                ).end()
-            _t.on_finish(completion)
-
         self.scheduler.submit(
             ticket.request,
-            on_token=on_token,
-            on_finish=on_finish,
+            on_token=lambda uid, tok, idx, _t=ticket: self._token_cb(_t, uid, tok, idx),
+            on_finish=lambda completion, _t=ticket: self._finish_cb(_t, completion),
             deadline=ticket.deadline,
             trace_id=ticket.trace_id,
         )
+
+    def _token_cb(self, ticket: Ticket, uid: int, token: int, index: int) -> None:
+        """Per-token bookkeeping shared by local decode and relayed migration
+        streams: latency histograms, the Retry-After TPOT estimate, and the
+        client's own on_token."""
+        now = time.monotonic()
+        if index == 0:
+            self.stats.observe("ttft_seconds", now - ticket.t_enqueue)
+        elif ticket.t_last_token is not None:
+            tpot = now - ticket.t_last_token
+            self.stats.observe("tpot_seconds", tpot)
+            self.admission.note_tpot(tpot)  # feeds the Retry-After hint
+        ticket.t_last_token = now
+        with self._emitted_lock:
+            self._tokens_emitted += 1
+        self.stats.inc("tokens_generated_total")
+        ticket.on_token(uid, token, index)
+
+    def _finish_cb(self, ticket: Ticket, completion: Completion) -> None:
+        """Finish bookkeeping shared by local decode and relayed migration
+        streams: counters, e2e latency, the root span, the client stream."""
+        self._active.pop(completion.uid, None)
+        self.stats.inc(
+            "requests_finished_total", ("reason", completion.finish_reason)
+        )
+        self.stats.observe("e2e_latency_seconds", time.monotonic() - ticket.t_enqueue)
+        if ticket.span is not None:
+            ticket.span.set(
+                finish_reason=completion.finish_reason,
+                output_tokens=len(completion.tokens),
+            ).end()
+        ticket.on_finish(completion)
+
+    # -- disaggregated handoff / fleet prefix fetch --------------------------
+    #
+    # Thread contract: the scheduler is model-thread-only, so every disagg
+    # mutation (handoff outcome, migrated-run insert, prefix export) crosses
+    # from the event loop through _disagg_inbox and is applied by
+    # _drain_disagg_inbox inside the model loop.  The donor-side relay
+    # (_migrate_task) and the internal HTTP handlers live on the event loop;
+    # _migration_sink and _prefix_fetch are called *by* the scheduler on the
+    # model thread.
+
+    def _drain_disagg_inbox(self) -> None:
+        """Model thread: apply queued cross-thread disagg work."""
+        sched = self.scheduler
+        while self._disagg_inbox:
+            kind, payload = self._disagg_inbox.popleft()
+            try:
+                if kind == "failed":
+                    sched.migration_failed(payload[0], payload[1])
+                elif kind == "commit":
+                    sched.migration_commit(payload[0], bytes_sent=payload[1])
+                elif kind == "abort":
+                    sched.migration_abort(payload[0], payload[1])
+                elif kind == "insert":
+                    self._apply_migrate_insert(*payload)
+                elif kind == "export_prefix":
+                    self._apply_prefix_export(*payload)
+            except Exception as e:
+                # inbox work must never kill the model thread; each message
+                # has its own fail-open story and this is the last resort
+                logger.warning(f"disagg inbox {kind!r} failed: {e!r}")
+
+    def _apply_migrate_insert(
+        self,
+        record: Dict[str, Any],
+        arrays: Any,
+        ticket: Ticket,
+        done: threading.Event,
+        result: Dict[str, Any],
+    ) -> None:
+        """Model thread: adopt a migrated page run into a decode slot.  Any
+        raise lands in ``result["error"]`` and the donor fails open."""
+        try:
+            if ticket.cancelled.is_set():
+                raise RuntimeError("donor went away before the insert")
+            self.scheduler.submit_migrated(
+                record,
+                arrays,
+                on_token=lambda uid, tok, idx, _t=ticket: self._token_cb(
+                    _t, uid, tok, idx
+                ),
+                on_finish=lambda completion, _t=ticket: self._finish_cb(
+                    _t, completion
+                ),
+                deadline=ticket.deadline,
+                trace_id=ticket.trace_id,
+            )
+            self._active[ticket.uid] = ticket
+        except Exception as e:
+            result["error"] = str(e)
+        finally:
+            done.set()
+
+    def _apply_prefix_export(
+        self, digest_hex: str, done: threading.Event, result: Dict[str, Any]
+    ) -> None:
+        """Model thread: pin + export a locally cached prefix run for a peer
+        (GET /internal/prefix/<digest>).  ``result["blob"]`` stays absent on
+        a miss — the handler answers 404 and the peer falls open.  The
+        acquire/decref pair is the donor-side pin: LRU eviction cannot free
+        the run while export_page_run is copying it off the device."""
+        try:
+            sched = self.scheduler
+            cache = getattr(sched, "prefix_cache", None)
+            if cache is None:
+                return
+            acquired = cache.acquire(digest_hex)
+            if acquired is None:
+                return
+            pages, n_tokens = acquired
+            try:
+                entries = sched.engine.export_page_run(sched._ensure_pool(), pages)
+            finally:
+                sched.allocator.decref(pages)  # release the transfer pin
+            result["blob"] = _encode_page_run(
+                {
+                    "digest": digest_hex,
+                    "n_tokens": n_tokens,
+                    "n_pages": len(pages),
+                },
+                entries,
+            )
+        except Exception as e:
+            result["error"] = str(e)
+        finally:
+            done.set()
+
+    def _migration_sink(self, record: Dict[str, Any], entries: Any) -> bool:
+        """Model thread (scheduler._maybe_migrate): pick decode peers, frame
+        the run, and launch the async handoff.  Returning False means the
+        handoff could not even start — the scheduler fails open on the spot."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return False
+        ticket = self._active.get(int(record["uid"]))
+        if ticket is None or ticket.cancelled.is_set():
+            return False
+        peers = disagg.load_peers(self.peer_file)
+        candidates = disagg.pick_peers(
+            peers, role="decode", exclude_rid=self.replica_id
+        )
+        if not candidates:
+            return False
+        # enrich with what only the server knows: the remaining deadline and
+        # the request id, so the peer's deadline/spans behave like a direct hit
+        if ticket.deadline is not None:
+            record["deadline_s"] = max(0.1, ticket.deadline - time.monotonic())
+        if ticket.trace_id:
+            record["trace_id"] = ticket.trace_id
+        try:
+            blob = _encode_page_run(record, entries)
+        except Exception as e:
+            logger.warning(f"request {record['uid']}: wire encode failed: {e!r}")
+            return False
+        asyncio.run_coroutine_threadsafe(
+            self._migrate_task(record, blob, ticket, candidates[:2]), loop
+        )
+        return True
+
+    async def _migrate_task(
+        self, record: Dict[str, Any], blob: bytes, ticket: Ticket, candidates: list
+    ) -> None:
+        """Event loop: drive the handoff against each candidate peer.  Per
+        attempt: "relayed" (peer finished the stream — commit the donor
+        slot), "rejected" (no token reached the client — the next peer, or
+        fail open to local decode, is still token-identical), "aborted"
+        (peer died after relaying a token — the PR 9 idempotency boundary
+        forbids a silent replay, so the client gets a typed error finish)."""
+        uid = int(record["uid"])
+        detail = "no decode peer accepted the handoff"
+        for peer in candidates:
+            try:
+                outcome, detail = await self._migrate_attempt(
+                    record, blob, ticket, peer
+                )
+            except Exception as e:
+                outcome, detail = "rejected", f"{peer.get('rid')}: {e!r}"
+            if outcome == "relayed":
+                self._disagg_inbox.append(("commit", (uid, len(blob))))
+                return
+            if outcome == "aborted":
+                self._disagg_inbox.append(("abort", (uid, detail)))
+                try:
+                    self._finish_cb(
+                        ticket,
+                        Completion(
+                            uid=uid,
+                            tokens=[],
+                            finish_reason="error",
+                            prompt_tokens=len(ticket.request.prompt),
+                            ttft_s=0.0,
+                            latency_s=time.monotonic() - ticket.t_enqueue,
+                            error=f"migration_failed: {detail}",
+                        ),
+                    )
+                except Exception:
+                    pass
+                if self.metrics is not None:
+                    self.metrics.event(
+                        "migration_failed", uid=uid, detail=str(detail), aborted=True
+                    )
+                return
+            logger.warning(
+                f"request {uid}: handoff to {peer.get('rid')} rejected ({detail})"
+            )
+        self._disagg_inbox.append(("failed", (uid, detail)))
+        if self.metrics is not None:
+            self.metrics.event("migration_failed", uid=uid, detail=str(detail))
+
+    async def _migrate_attempt(
+        self, record: Dict[str, Any], blob: bytes, ticket: Ticket, peer: Dict[str, Any]
+    ) -> Tuple[str, str]:
+        """One POST /internal/migrate exchange: ship the framed run, then
+        relay the peer's SSE continuation into the client's ticket callbacks.
+        Returns ("relayed" | "rejected" | "aborted", detail)."""
+        host = str(peer.get("host") or "127.0.0.1")
+        port = int(peer["port"])
+        uid = int(record["uid"])
+        relayed_any = False
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout=5.0
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            return "rejected", f"connect {host}:{port}: {e!r}"
+        try:
+            writer.write(
+                (
+                    f"POST /internal/migrate HTTP/1.1\r\n"
+                    f"Host: {host}:{port}\r\n"
+                    f"Content-Type: application/octet-stream\r\n"
+                    f"Content-Length: {len(blob)}\r\n"
+                    f"Connection: close\r\n\r\n"
+                ).encode()
+            )
+            writer.write(blob)
+            await asyncio.wait_for(writer.drain(), timeout=self.migrate_timeout_s)
+            status_line = await asyncio.wait_for(
+                reader.readline(), timeout=self.migrate_timeout_s
+            )
+            parts = status_line.decode("latin-1", "replace").split()
+            status = int(parts[1]) if len(parts) >= 2 and parts[1].isdigit() else 0
+            while True:  # response headers; SSE or JSON body follows
+                line = await asyncio.wait_for(
+                    reader.readline(), timeout=self.migrate_timeout_s
+                )
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if status != 200:
+                body = await reader.read(4096)
+                return "rejected", f"{host}:{port} -> {status} {body[:200]!r}"
+            while True:
+                if ticket.cancelled.is_set():
+                    # client left: abandon the relay (closing our end is the
+                    # peer's disconnect signal — it cancels and frees pages),
+                    # count the cancel, and commit the donor slot away
+                    self._finish_cb(
+                        ticket,
+                        Completion(
+                            uid=uid,
+                            tokens=[],
+                            finish_reason="cancelled",
+                            prompt_tokens=len(ticket.request.prompt),
+                            ttft_s=0.0,
+                            latency_s=time.monotonic() - ticket.t_enqueue,
+                        ),
+                    )
+                    return "relayed", "client cancelled mid-relay"
+                line = await asyncio.wait_for(
+                    reader.readline(), timeout=self.migrate_timeout_s
+                )
+                if not line:
+                    if relayed_any:
+                        return "aborted", f"{host}:{port}: peer died mid-stream"
+                    return "rejected", f"{host}:{port}: peer died before first token"
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[len(b"data: ") :]
+                if data == b"[DONE]":
+                    continue  # finish record already handled below
+                try:
+                    rec = json.loads(data.decode("utf-8"))
+                except (UnicodeDecodeError, json.JSONDecodeError):
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if "finish_reason" in rec:
+                    if rec["finish_reason"] == "error" and not relayed_any:
+                        # peer failed before anything reached the client:
+                        # safe to try the next peer / fail open locally
+                        return "rejected", f"{host}:{port}: {rec.get('error')}"
+                    self._finish_cb(
+                        ticket,
+                        Completion(
+                            uid=uid,
+                            tokens=[int(t) for t in rec.get("tokens", [])],
+                            finish_reason=str(rec["finish_reason"]),
+                            prompt_tokens=int(
+                                rec.get("prompt_tokens", len(ticket.request.prompt))
+                            ),
+                            ttft_s=float(rec.get("ttft_s", 0.0)),
+                            latency_s=time.monotonic() - ticket.t_enqueue,
+                            error=rec.get("error"),
+                        ),
+                    )
+                    return "relayed", "ok"
+                if "token" in rec:
+                    relayed_any = True
+                    self._token_cb(ticket, uid, int(rec["token"]), int(rec["index"]))
+        except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+            if relayed_any:
+                return "aborted", f"{host}:{port}: {e!r}"
+            return "rejected", f"{host}:{port}: {e!r}"
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _prefix_fetch(self, digests: list) -> Optional[Tuple[int, Any, int]]:
+        """Model thread (scheduler._fetch_prefix): resolve the longest known
+        prefix digest via the fleet directory, then pull the run from the
+        holder's /internal/prefix endpoint.  Returns ``(n_tokens, entries,
+        nbytes)`` or None; raises propagate into the scheduler's fail-open
+        accounting (prefix_fetch_failures_total)."""
+        url = self.fleet_url
+        if not url:
+            return None
+        if os.path.exists(url):
+            # the supervisor hands replicas a router-port *file* (the router
+            # binds an ephemeral port after the replicas spawn)
+            try:
+                with open(url) as f:
+                    url = f.read().strip()
+                if ":" not in url:
+                    url = f"127.0.0.1:{int(url)}"
+            except (OSError, ValueError):
+                return None
+        parts = urlsplit(url if "//" in url else f"//{url}")
+        status, body = disagg.http_fetch(
+            parts.hostname or "127.0.0.1",
+            parts.port or 80,
+            "/fleet/prefix?d=" + ",".join(digests) + "&exclude=" + self.replica_id,
+            timeout_s=2.0,
+        )
+        if status != 200:
+            return None
+        doc = json.loads(body.decode("utf-8"))
+        digest = doc.get("digest")
+        if not digest or doc.get("replica") == self.replica_id:
+            return None
+        status, blob = disagg.http_fetch(
+            str(doc["host"]),
+            int(doc["port"]),
+            f"/internal/prefix/{digest}",
+            timeout_s=5.0,
+        )
+        if status != 200:
+            return None  # stale directory entry: the holder evicted the run
+        meta, arrays = _decode_page_run(blob)
+        return int(meta["n_tokens"]), arrays, len(blob)
 
     # -- asyncio handlers ----------------------------------------------------
 
@@ -717,6 +1107,18 @@ class GenerateServer:
                 await _respond_json(writer, 405, {"error": "use POST"})
                 return
             await self._handle_reload(writer, body)
+        elif route == "/internal/migrate":
+            self.stats.inc("http_requests_total", ("route", "migrate"))
+            if method != "POST":
+                await _respond_json(writer, 405, {"error": "use POST"})
+                return
+            await self._handle_migrate(reader, writer, body)
+        elif route.startswith("/internal/prefix/"):
+            self.stats.inc("http_requests_total", ("route", "prefix"))
+            if method != "GET":
+                await _respond_json(writer, 405, {"error": "use GET"})
+                return
+            await self._handle_prefix(writer, route[len("/internal/prefix/") :])
         else:
             self.stats.inc("http_requests_total", ("route", "other"))
             await _respond_json(writer, 404, {"error": f"no route {route}"})
@@ -748,7 +1150,17 @@ class GenerateServer:
             # is what a rolling updater reads back for its rollback target
             "weights_version": self.weights_version,
             "weights_checkpoint": self.weights_checkpoint,
+            # disaggregated tier: the router reads role for pool routing; the
+            # collector feeds the fleet prefix-page directory from the digest
+            # list (both skipped by its numeric-only metrics ingestion)
+            "role": self.role,
         }
+        prefix_cache = getattr(self.scheduler, "prefix_cache", None)
+        if prefix_cache is not None:
+            try:
+                payload["prefix_digests"] = prefix_cache.digests()
+            except RuntimeError:
+                pass  # model thread mutated the cache mid-iteration; next probe
         if self._worker_error is not None:
             payload["detail"] = f"model thread died: {self._worker_error!r}"
         elif self._stuck:
@@ -836,6 +1248,101 @@ class GenerateServer:
                 **({"error": req.error} if req.error else {}),
             },
         )
+
+    async def _handle_migrate(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        body: bytes,
+    ) -> None:
+        """POST /internal/migrate — adopt a donor's finished page run into a
+        decode slot and stream the continuation back as SSE (the donor
+        relays it to the real client).  Every rejection is a non-200 the
+        donor maps to fail-open local decode, so rejecting here is always
+        safe; accepting means this replica now owns the request's stream."""
+        if self._worker_error is not None or self._warming or self.admission.draining:
+            await _respond_json(writer, 503, {"error": "replica not accepting handoffs"})
+            return
+        try:
+            record, arrays = _decode_page_run(body)
+            if not isinstance(record, dict):
+                raise ValueError("page-run meta must be an object")
+            req = Request(
+                uid=int(record["uid"]),
+                prompt=[int(t) for t in record["prompt"]],
+                max_new_tokens=int(record["max_new_tokens"]),
+                temperature=float(record.get("temperature", 0.0)),
+                top_p=float(record.get("top_p", 1.0)),
+                spec=bool(record.get("spec", True)),
+                adapter=record.get("adapter"),
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            await _respond_json(writer, 400, {"error": f"bad page run: {e}"})
+            return
+        loop = asyncio.get_running_loop()
+        events: "asyncio.Queue[Tuple[str, Any, Any]]" = asyncio.Queue()
+
+        def post(kind: str, a: Any = None, b: Any = None) -> None:
+            try:
+                loop.call_soon_threadsafe(events.put_nowait, (kind, a, b))
+            except RuntimeError:
+                pass
+
+        deadline_s = record.get("deadline_s")
+        ticket = Ticket(
+            uid=req.uid,
+            request=req,
+            deadline=(
+                time.monotonic() + float(deadline_s)
+                if isinstance(deadline_s, (int, float)) and deadline_s > 0
+                else None
+            ),
+            on_token=lambda uid, tok, idx: post("token", tok, idx),
+            on_finish=lambda completion: post("finish", completion),
+            trace_id=record.get("trace_id"),
+        )
+        done = threading.Event()
+        result: Dict[str, Any] = {}
+        self._disagg_inbox.append(("insert", (record, arrays, ticket, done, result)))
+        ok = await loop.run_in_executor(None, done.wait, self.migrate_timeout_s)
+        if not ok:
+            # flag the ticket so a late insert is rejected (or, if it already
+            # landed, the cancel scan frees the slot) — never decode blind
+            ticket.cancelled.set()
+            await _respond_json(writer, 503, {"error": "migrated insert timed out"})
+            return
+        if result.get("error"):
+            await _respond_json(writer, 409, {"error": result["error"]})
+            return
+        await self._stream_response(reader, writer, ticket, events)
+
+    async def _handle_prefix(self, writer: asyncio.StreamWriter, digest_hex: str) -> None:
+        """GET /internal/prefix/<digest> — export a pinned prefix page run
+        for a peer.  404 on a miss (stale directory entry): the requester
+        falls open to local prefill."""
+        if self._worker_error is not None or self._warming:
+            await _respond_json(writer, 503, {"error": "replica not serving prefixes"})
+            return
+        done = threading.Event()
+        result: Dict[str, Any] = {}
+        self._disagg_inbox.append(
+            ("export_prefix", (digest_hex.strip(), done, result))
+        )
+        loop = asyncio.get_running_loop()
+        ok = await loop.run_in_executor(None, done.wait, 10.0)
+        blob = result.get("blob") if ok else None
+        if blob is None:
+            await _respond_json(
+                writer,
+                404,
+                {"error": result.get("error") or "prefix not cached on this replica"},
+            )
+            return
+        writer.write(
+            _head(200, "OK", "application/octet-stream", content_length=len(blob))
+        )
+        writer.write(blob)
+        await writer.drain()
 
     async def _handle_generate(
         self,

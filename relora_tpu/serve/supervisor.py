@@ -120,11 +120,19 @@ class ReplicaSupervisor:
         env_overrides: Optional[Dict[int, Dict[str, str]]] = None,
         env_overrides_respawn: bool = True,
         on_event: Optional[Callable[[str, int, Dict], None]] = None,
+        roles: Optional[Dict[int, str]] = None,
+        peer_file: Optional[str] = None,
     ):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.command = command
         self.workdir = workdir
+        # disaggregated fleet: replica index -> role (absent = "mixed"); the
+        # supervisor maintains peer_file (peers.json) so prefill replicas can
+        # find decode peers without a discovery service
+        self.roles = dict(roles or {})
+        self.peer_file = peer_file
+        self._last_peers: Optional[str] = None
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.backoff_jitter = backoff_jitter
@@ -422,6 +430,36 @@ class ReplicaSupervisor:
                     continue  # begin_rolling_drain owns the processes now
                 for rep in self._replicas:
                     self._check(rep)
+            self._update_peers()
+
+    def role_of(self, idx: int) -> str:
+        return self.roles.get(idx, "mixed")
+
+    def _update_peers(self) -> None:
+        """Keep peers.json current with the bound fleet: {rid, host, port,
+        role} per live replica.  Written atomically and only on change (the
+        replicas mtime-cache it via disagg.load_peers)."""
+        if self.peer_file is None:
+            return
+        import json as _json
+
+        replicas = [
+            {"rid": rid, "host": host, "port": port,
+             "role": self.role_of(int(rid[1:]))}  # noqa: RTL202 - rid string parse
+            for rid, (host, port) in sorted(self.endpoints().items())
+            if port is not None
+        ]
+        doc = _json.dumps({"replicas": replicas}, sort_keys=True)
+        if doc == self._last_peers:
+            return
+        tmp = self.peer_file + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(doc)
+            os.replace(tmp, self.peer_file)
+            self._last_peers = doc
+        except OSError as e:
+            logger.warning(f"peers.json update failed: {e!r}")
 
     def _check(self, rep: _Replica) -> None:
         now = time.monotonic()
@@ -489,6 +527,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "appends --port 0 --port-file <workdir>/replica_<i>.port to it.",
     )
     p.add_argument("--replicas", type=int, default=2)
+    p.add_argument(
+        "--prefill-replicas",
+        type=int,
+        default=0,
+        help="disaggregated fleet: the first N replicas run --role prefill "
+        "(long prompts; finished page runs migrate to decode peers)",
+    )
+    p.add_argument(
+        "--decode-replicas",
+        type=int,
+        default=0,
+        help="disaggregated fleet: the next N replicas run --role decode "
+        "(short prompts + migrated runs); the rest stay mixed/fallback",
+    )
+    p.add_argument(
+        "--classify-threshold",
+        type=int,
+        default=None,
+        help="prompt-length (tokens) routing threshold between the decode "
+        "and prefill pools (default 128 when roles are in play)",
+    )
     p.add_argument("--workdir", required=True, help="port/pid/log files live here")
     p.add_argument("--router-host", default="127.0.0.1")
     p.add_argument("--router-port", type=int, default=8000, help="0 = ephemeral")
@@ -589,10 +648,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from relora_tpu.obs.fleet import FleetCollector  # jax-free, like this module
     from relora_tpu.obs.slo import SLOEngine
+    from relora_tpu.serve import disagg as _disagg
     from relora_tpu.serve.router import Router
 
+    # disaggregated fleet: the first --prefill-replicas indices are prefill,
+    # the next --decode-replicas are decode, the rest mixed (the fallback
+    # pool).  Each replica learns its role + the peer roster via flags the
+    # supervisor appends to the base command; the fleet-url file lets them
+    # reach the collector's prefix directory once the router has bound.
+    if args.prefill_replicas + args.decode_replicas > args.replicas:
+        raise SystemExit("--prefill-replicas + --decode-replicas exceeds --replicas")
+    disagg_on = args.prefill_replicas + args.decode_replicas > 0
+    roles: Dict[int, str] = {}
+    for i in range(args.prefill_replicas):
+        roles[i] = "prefill"
+    for i in range(args.prefill_replicas, args.prefill_replicas + args.decode_replicas):
+        roles[i] = "decode"
+    peer_file = os.path.join(args.workdir, "peers.json") if disagg_on else None
+    router_port_path = os.path.join(args.workdir, "router.port")
+    replica_command: ReplicaCommand = command
+    if disagg_on:
+
+        def replica_command(idx: int, port_file: str) -> List[str]:
+            return list(command) + [
+                "--port", "0",
+                "--port-file", port_file,
+                "--role", roles.get(idx, "mixed"),
+                "--peer-file", peer_file,
+                "--fleet-url", router_port_path,
+            ]
+
     sup = ReplicaSupervisor(
-        command,
+        replica_command,
         args.replicas,
         args.workdir,
         backoff_base_s=args.backoff_base_s,
@@ -602,6 +689,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         drain_timeout_s=args.drain_timeout_s,
         env_overrides=env_overrides,
         env_overrides_respawn=False,
+        roles=roles,
+        peer_file=peer_file,
     )
 
     # fleet observability plane: the collector scrapes every replica plus the
@@ -635,6 +724,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         port=args.router_port,
         probe_interval_s=args.probe_interval_s,
         extra_routes=collector.handle_fleet_route if collector is not None else None,
+        classify_threshold=(
+            (
+                args.classify_threshold
+                if args.classify_threshold is not None
+                else _disagg.DEFAULT_CLASSIFY_THRESHOLD
+            )
+            if disagg_on
+            else args.classify_threshold
+        ),
     )
     router_holder["router"] = router
     sup.start()
@@ -727,9 +825,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             await asyncio.sleep(0.01)
             if serve.done():
                 break
-        if args.router_port_file and not serve.done():
-            with open(args.router_port_file, "w") as f:
+        if not serve.done():
+            # workdir copy feeds the replicas' --fleet-url (the collector's
+            # /fleet/prefix directory mounts on the router front-end)
+            with open(router_port_path, "w") as f:
                 f.write(str(router.port))
+            if args.router_port_file:
+                with open(args.router_port_file, "w") as f:
+                    f.write(str(router.port))
         await serve
 
     try:

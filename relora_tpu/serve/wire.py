@@ -17,9 +17,14 @@ from __future__ import annotations
 
 import asyncio
 import json
-from typing import Any, Dict, Optional, Tuple
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 MAX_BODY_BYTES = 16 << 20
+
+#: frame magic for the binary page-run transfer format (bump on layout change)
+PAGE_RUN_MAGIC = b"RPR1"
 
 REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
@@ -81,6 +86,127 @@ async def respond_json(
         content_type="application/json",
         extra_headers=extra_headers,
     )
+
+
+def encode_page_run(
+    meta: Dict[str, Any],
+    arrays: Sequence[Tuple[str, str, Sequence[int], bytes]],
+) -> bytes:
+    """Frame a migrated KV page run for the internal transfer endpoint.
+
+    ``arrays`` is ``(name, dtype, shape, raw_bytes)`` per pool leaf — the
+    caller (engine.export_page_run) flattens device arrays to host bytes;
+    this module stays numpy-free so the linter/front-end import rule holds.
+
+    Layout: ``RPR1 | u32 header_len | header JSON | payload bytes | u32 crc``
+    where the header records meta plus per-array (name, dtype, shape, nbytes)
+    and the trailing crc32 covers everything before it.  ``decode_page_run``
+    raises ValueError on anything torn, truncated, or corrupt — receivers
+    fail open to local recompute, never decode garbage into the pool.
+    """
+    entries = []
+    payload = bytearray()
+    for name, dtype, shape, raw in arrays:
+        if len(raw) > MAX_BODY_BYTES:
+            raise ValueError(f"page-run array {name!r} too large: {len(raw)} bytes")
+        entries.append(
+            {"name": name, "dtype": dtype, "shape": list(shape), "nbytes": len(raw)}
+        )
+        payload += raw
+    header = json.dumps({"meta": meta, "arrays": entries}).encode()
+    blob = PAGE_RUN_MAGIC + struct.pack("<I", len(header)) + header + bytes(payload)
+    return blob + struct.pack("<I", zlib.crc32(blob) & 0xFFFFFFFF)
+
+
+def decode_page_run(
+    blob: bytes,
+) -> Tuple[Dict[str, Any], List[Tuple[str, str, Tuple[int, ...], bytes]]]:
+    """Inverse of :func:`encode_page_run`.  Raises ValueError on a torn or
+    corrupt frame (short blob, bad magic, bad crc, header/payload length
+    mismatch) so callers can fall back instead of ingesting garbage."""
+    if len(blob) < len(PAGE_RUN_MAGIC) + 8:
+        raise ValueError(f"page-run blob truncated: {len(blob)} bytes")
+    if blob[: len(PAGE_RUN_MAGIC)] != PAGE_RUN_MAGIC:
+        raise ValueError(f"bad page-run magic: {blob[:4]!r}")
+    body, (crc,) = blob[:-4], struct.unpack("<I", blob[-4:])
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise ValueError("page-run crc mismatch (torn transfer?)")
+    (header_len,) = struct.unpack("<I", blob[4:8])
+    header_end = 8 + header_len
+    if header_end > len(body):
+        raise ValueError("page-run header overruns blob")
+    try:
+        header = json.loads(body[8:header_end].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"page-run header unparseable: {e}") from e
+    if not isinstance(header, dict) or "meta" not in header or "arrays" not in header:
+        raise ValueError("page-run header missing meta/arrays")
+    arrays: List[Tuple[str, str, Tuple[int, ...], bytes]] = []
+    off = header_end
+    for ent in header["arrays"]:
+        nbytes = int(ent["nbytes"])
+        if nbytes < 0 or off + nbytes > len(body):
+            raise ValueError(f"page-run array {ent.get('name')!r} overruns payload")
+        arrays.append(
+            (str(ent["name"]), str(ent["dtype"]), tuple(int(d) for d in ent["shape"]),
+             body[off : off + nbytes])
+        )
+        off += nbytes
+    if off != len(body):
+        raise ValueError(f"page-run trailing garbage: {len(body) - off} bytes")
+    return header["meta"], arrays
+
+
+def build_migration_record(
+    *,
+    uid: int,
+    prompt: Sequence[int],
+    max_new_tokens: int,
+    temperature: float,
+    top_p: float,
+    spec: bool,
+    adapter: Optional[str],
+    first_token: int,
+    position: int,
+    token_index: int,
+    n_pages: int,
+) -> Dict[str, Any]:
+    """The migration record's canonical JSON shape, in one place.  The casts
+    normalize whatever host scalars the donor scheduler holds (numpy ints
+    from the sampling pull, plain python ints) into JSON-native types; this
+    runs at transfer cadence, outside the decode loop."""
+    return {
+        "uid": int(uid),
+        "prompt": [int(t) for t in prompt],
+        "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature),
+        "top_p": float(top_p),
+        "spec": bool(spec),
+        "adapter": adapter,
+        "first_token": int(first_token),
+        "position": int(position),
+        "token_index": int(token_index),
+        "n_pages": int(n_pages),
+    }
+
+
+def parse_migration_record(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Re-normalize an inbound migration record's fields to host scalars.
+    Raises KeyError/ValueError/TypeError on a malformed record — receivers
+    map any raise to a rejected handoff, so strictness here is safe."""
+    return {
+        "uid": int(record["uid"]),
+        "prompt": [int(t) for t in record["prompt"]],
+        "max_new_tokens": int(record["max_new_tokens"]),
+        "temperature": float(record.get("temperature", 0.0)),
+        "top_p": float(record.get("top_p", 1.0)),
+        "spec": bool(record.get("spec", True)),
+        "adapter": record.get("adapter"),
+        "first_token": int(record["first_token"]),
+        "position": int(record["position"]),
+        "token_index": int(record.get("token_index", 1)),
+        "n_pages": int(record["n_pages"]),
+    }
 
 
 async def read_http_request(

@@ -35,6 +35,17 @@ Serving sites (hooked by ``serve/server.py``, drilled in
   ``maybe_fail``: the server closes the first ``times`` accepted
   connections without a byte of response (router retry drill).
 
+Disaggregation sites (hooked by ``serve/scheduler.py``, drilled in
+``tests/test_disagg.py`` and smoke stage 16):
+
+- ``maybe_fail("serve_migrate")``      — raise at the donor's page-run
+  export boundary (``exc=...``); the prefill replica must fail open to
+  local decode — a typed ``migration_failed`` event and a token-identical
+  stream, never a dropped or silently-replayed request.
+- ``maybe_fail("serve_prefix_fetch")`` — raise at the peer prefix-fetch
+  boundary (``exc=...``); the local prefix-cache miss must fall back to
+  recomputing the prefill locally, never surface to the client.
+
 Deployment sites (hooked by ``serve/deploy.py`` / ``serve/server.py``,
 drilled in ``tests/test_deploy.py`` and smoke stage 14):
 

@@ -1065,4 +1065,204 @@ grep -q "autoscale_down_complete" "$WORK/as_report.txt"
 grep -q "replicas:" "$WORK/as_report.txt"
 grep "autoscale_" "$WORK/as_report.txt" | head -12
 
+echo "=== 16. disaggregated fleet: prefill/decode roles, KV page migration, prefix directory ==="
+# reference first: one *mixed* paged replica records the greedy tokens the
+# disaggregated fleet must reproduce exactly (same checkpoint, same pool)
+rm -f "$WORK/dg_ref_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/dg_ref_port" --max-batch 2 --max-queue 8 \
+    --cache-size 64 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --kv-dtype int8 &
+DG_REF_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/dg_ref_port" ] && break; sleep 0.2; done
+[ -s "$WORK/dg_ref_port" ] || { echo "reference server never wrote its port"; kill "$DG_REF_PID"; exit 1; }
+python - "$(cat "$WORK/dg_ref_port")" "$WORK/dg_ref.json" <<'EOF'
+import json, sys, time, urllib.error, urllib.request
+port = sys.argv[1]
+deadline = time.time() + 600
+while True:
+    try:
+        health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+    except urllib.error.HTTPError as e:
+        health = json.load(e)
+    if health["status"] == "ok":
+        break
+    assert health["status"] == "warming" and time.time() < deadline, health
+    time.sleep(0.5)
+assert health["role"] == "mixed", health
+
+def generate(prompt):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 8}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [
+            line[len(b"data: "):].strip()
+            for line in resp
+            if line.startswith(b"data: ")
+        ]
+    assert events[-1] == b"[DONE]", events[-3:]
+    return json.loads(events[-2])["tokens"]
+
+short = [(i % 100) + 1 for i in range(8)]
+long1 = [(i % 100) + 1 for i in range(40)]
+long2 = long1[:32] + [7, 8, 9, 10, 11, 12, 13, 14]  # shared 4-page prefix
+json.dump(
+    {"short": generate(short), "long1": generate(long1), "long2": generate(long2)},
+    open(sys.argv[2], "w"),
+)
+print("disagg reference tokens recorded")
+EOF
+kill -TERM "$DG_REF_PID"
+wait "$DG_REF_PID"
+
+# the disaggregated fleet: replica 0 prefill, replica 1 decode, replica 2
+# mixed (the fallback pool), router classifying at 24 prompt tokens, the
+# collector (0.2s cadence) feeding the fleet prefix-page directory
+DG_FLEET="$WORK/dg_fleet"
+rm -rf "$DG_FLEET"; mkdir -p "$DG_FLEET"
+rm -f "$WORK/dg_router_port"
+python -m relora_tpu.serve.supervisor --replicas 3 \
+    --prefill-replicas 1 --decode-replicas 1 --classify-threshold 24 \
+    --workdir "$DG_FLEET" \
+    --router-port 0 --router-port-file "$WORK/dg_router_port" \
+    --backoff-base-s 0.2 --probe-interval-s 0.1 --fleet-cadence-s 0.2 -- \
+    python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --max-batch 2 --max-queue 8 --cache-size 64 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --kv-dtype int8 &
+DG_SUP_PID=$!
+for _ in $(seq 600); do [ -s "$WORK/dg_router_port" ] && break; sleep 0.2; done
+[ -s "$WORK/dg_router_port" ] || { echo "router never wrote its port"; kill "$DG_SUP_PID"; exit 1; }
+python - "$(cat "$WORK/dg_router_port")" "$DG_FLEET" "$WORK/dg_ref.json" <<'EOF'
+import json, os, signal, sys, time, urllib.error, urllib.request
+
+port, fleet, want = sys.argv[1], sys.argv[2], json.load(open(sys.argv[3]))
+base = f"http://127.0.0.1:{port}"
+
+def healthz(p=None, b=None):
+    url = b or (f"http://127.0.0.1:{p}" if p else base)
+    try:
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read().decode())
+
+def wait_healthy(n, tries=1500):
+    h = {}
+    for _ in range(tries):
+        h = healthz()
+        if h.get("healthy_replicas", 0) >= n:
+            return h
+        time.sleep(0.2)
+    raise SystemExit(f"fleet never reached {n} healthy replicas: {h}")
+
+def stream(prompt, kill_mid_stream=False):
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 8}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        rid = resp.headers["X-Relora-Replica"]
+        events = []
+        for line in resp:
+            if not line.startswith(b"data: "):
+                continue
+            events.append(line[len(b"data: "):].strip())
+            if kill_mid_stream and len(events) == 1:
+                pid = int(open(os.path.join(fleet, f"replica_{rid[1:]}.pid")).read())
+                os.kill(pid, signal.SIGKILL)
+    return rid, events
+
+wait_healthy(3)
+# replica roles come up exactly as assigned (healthz is the role advertisement)
+role_of = {}
+for i in range(3):
+    rp = open(os.path.join(fleet, f"replica_{i}.port")).read().strip()
+    role_of[f"r{i}"] = healthz(p=rp)["role"]
+assert sorted(role_of.values()) == ["decode", "mixed", "prefill"], role_of
+assert role_of["r0"] == "prefill" and role_of["r1"] == "decode", role_of
+
+short, long1, long2 = (
+    [(i % 100) + 1 for i in range(8)],
+    [(i % 100) + 1 for i in range(40)],
+    [(i % 100) + 1 for i in range(32)] + [7, 8, 9, 10, 11, 12, 13, 14],
+)
+
+def final(events):
+    assert events[-1] == b"[DONE]", events[-3:]
+    return json.loads(events[-2])["tokens"]
+
+# short prompt -> decode pool; long prompt -> prefill pool, whose finished
+# page run migrates to the decode peer mid-stream.  Either way the tokens
+# must be exactly what the single mixed replica produced.
+rid, events = stream(short)
+assert role_of[rid] == "decode", (rid, role_of)
+assert final(events) == want["short"], (final(events), want["short"])
+rid, events = stream(long1)
+assert role_of[rid] == "prefill", (rid, role_of)
+assert final(events) == want["long1"], (final(events), want["long1"])
+rid, events = stream(long2)
+assert final(events) == want["long2"], (final(events), want["long2"])
+
+# the long streams really were handed off: donor-side migration counters
+prefill_port = open(os.path.join(fleet, "replica_0.port")).read().strip()
+for _ in range(100):
+    m = urllib.request.urlopen(f"http://127.0.0.1:{prefill_port}/metrics", timeout=10).read().decode()
+    migrated = [l for l in m.splitlines() if l.startswith("relora_serve_pages_migrated_total")]
+    if migrated and float(migrated[0].split()[-1]) > 0:
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit(f"prefill replica never migrated a page run: {migrated}")
+router_metrics = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+assert "relora_router_routed_prefill_total" in router_metrics, router_metrics
+assert "relora_router_routed_decode_total" in router_metrics, router_metrics
+
+# fleet prefix-page directory: the collector scraped the prefill replica's
+# digest advertisement; the router resolves a digest to its holder
+digests = healthz(p=prefill_port).get("prefix_digests") or []
+assert digests, "prefill replica advertises no prefix digests after long prompts"
+holder = None
+for _ in range(100):  # collector cadence: the next scrape feeds the directory
+    try:
+        with urllib.request.urlopen(f"{base}/fleet/prefix?d={digests[0]}", timeout=10) as r:
+            holder = json.load(r)
+            break
+    except urllib.error.HTTPError:
+        time.sleep(0.2)
+assert holder and holder["digest"] == digests[0] and holder["port"], holder
+print(f"prefix directory resolves {digests[0][:12]}... -> {holder['replica']}")
+
+# SIGKILL the prefill replica mid-stream: bytes already reached the client,
+# so the stream must end with a typed error (never a hang, never a replay)
+victim, events = stream(long1, kill_mid_stream=True)
+assert role_of[victim] == "prefill", (victim, role_of)
+if events[-1] == b"[DONE]":
+    print("note: victim finished its stream before the SIGKILL landed")
+else:
+    err = json.loads(events[-1]).get("error", {})
+    assert err.get("type") == "stream_interrupted", events[-3:]
+    assert err.get("retryable") is False, err
+
+# with the prefill pool empty the router falls back to the mixed replica —
+# same tokens, zero dropped requests
+rid, events = stream(long1)
+assert role_of[rid] == "mixed", (rid, role_of)
+assert final(events) == want["long1"], (final(events), want["long1"])
+
+# the supervisor restarts the victim; the rearmed prefill pool serves again
+wait_healthy(3)
+for _ in range(60):
+    rid, events = stream(long2)
+    assert final(events) == want["long2"], (final(events), want["long2"])
+    if rid == victim:
+        break
+else:
+    raise SystemExit(f"restarted prefill replica {victim} never served traffic again")
+print("disagg fleet OK: role routing, token-identical migration, typed SIGKILL fallback")
+EOF
+kill -TERM "$DG_SUP_PID"
+wait "$DG_SUP_PID"   # exit 0 = rolling drain across all three roles
+
 echo "SMOKE OK"

@@ -150,6 +150,34 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="paged: disable shared-prefix page reuse",
     )
     p.add_argument(
+        "--role",
+        choices=("prefill", "decode", "mixed"),
+        default="mixed",
+        help="disaggregated fleet role (docs/serving.md): 'prefill' replicas "
+        "hand finished prompts' KV pages to a decode peer over "
+        "/internal/migrate, 'decode' replicas adopt them, 'mixed' serves "
+        "everything (the fallback pool); requires --paged for prefill/decode",
+    )
+    p.add_argument(
+        "--peer-file",
+        default=None,
+        help="disagg: supervisor-maintained peers.json roster path (prefill "
+        "replicas pick migration targets from it); requires --port",
+    )
+    p.add_argument(
+        "--fleet-url",
+        default=None,
+        help="disagg: the collector's /fleet/prefix directory — 'host:port' "
+        "or a file containing the port (the supervisor's router.port); a "
+        "local prefix-cache miss becomes a peer page fetch; requires --port",
+    )
+    p.add_argument(
+        "--migrate-timeout-s",
+        type=float,
+        default=30.0,
+        help="disagg: per-I/O timeout on the migration wire transfer",
+    )
+    p.add_argument(
         "--spec",
         choices=("off", "ngram"),
         default="off",
@@ -264,6 +292,13 @@ def main(argv=None) -> int:
             )
     if args.adapter_dir is not None and not os.path.isdir(args.adapter_dir):
         raise SystemExit(f"--adapter-dir {args.adapter_dir} is not a directory")
+    if args.role != "mixed" and not args.paged:
+        raise SystemExit(
+            f"--role {args.role} requires --paged (KV-page migration ships "
+            "page runs; the contiguous cache has none)"
+        )
+    if (args.peer_file or args.fleet_url) and args.port is None:
+        raise SystemExit("--peer-file/--fleet-url configure the HTTP server; pass --port")
     if args.watch_checkpoints is not None:
         if args.port is None:
             raise SystemExit(
@@ -426,6 +461,7 @@ def main(argv=None) -> int:
                 prefix_cache=not args.no_prefix_cache,
                 spec=args.spec,
                 packed=args.packed,
+                role=args.role,
                 **common,
             )
         return ContinuousBatchingScheduler(engine, **common)
@@ -466,9 +502,18 @@ def main(argv=None) -> int:
         # first request pays the compiles.
         warmup_fn = None
         if not args.no_warmup:
+            # a disagg replica also warms the page-run gather/scatter programs
+            # (export on the donor, import on the receiver) so the first
+            # migration is not a steady-state retrace
+            disagg_on = args.paged and (
+                args.role != "mixed" or bool(args.peer_file) or bool(args.fleet_url)
+            )
+
             def warmup_fn():
                 logger.info("warming serving compiles (disable with --no-warmup)")
-                report = engine.warmup(args.max_batch, packed=args.packed)
+                report = engine.warmup(
+                    args.max_batch, packed=args.packed, migrate=disagg_on
+                )
                 timings = ", ".join(
                     f"{c['fn']} {c['duration_s']:.2f}s" for c in report["compiles"]
                 )
@@ -566,6 +611,9 @@ def main(argv=None) -> int:
             host=args.host,
             port=args.port,
             max_queue=args.max_queue,
+            peer_file=args.peer_file,
+            fleet_url=args.fleet_url,
+            migrate_timeout_s=args.migrate_timeout_s,
             default_max_new_tokens=args.max_new_tokens,
             default_temperature=args.temperature,
             default_top_p=args.top_p,

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Ten rules, each skipped gracefully when its input files are absent:
+Eleven rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -53,6 +53,13 @@ Ten rules, each skipped gracefully when its input files are absent:
    kernel's scalar-prefetch indirection must be ~free when every row hits
    one slot.  Skipped when the artifact was recorded in interpreter mode
    (``detail.fused_is_interpret``).
+11. **disaggregated handoff** (``BENCH_http.json`` ``detail.disagg_run``):
+   the prefill→decode scheduler pair draining the long+short mix through
+   the migration wire must finish token-identical to the single mixed
+   scheduler with zero dropped requests on every kv_dtype arm, and the
+   int8 arm's migrated bytes must be at most 0.3x the bf16 arm's — the
+   quantized page payload is the whole point of migrating int8 pools.
+   Structural — counts and parity, not time — so it runs everywhere.
 
 Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
 ``--warn-only`` reports failures but exits 0 — CI uses it off-TPU where the
@@ -398,6 +405,54 @@ def check_autoscale(bench_dir: str) -> List[str]:
     return failures
 
 
+def check_disagg(bench_dir: str) -> List[str]:
+    """Disaggregated-handoff rules over ``detail.disagg_run`` in
+    BENCH_http.json (present for paged serve_load runs):
+
+    - every kv_dtype arm must finish **token-identical** to the single
+      mixed-scheduler baseline — migrating a page run across the wire must
+      not perturb a single sampled token;
+    - ``dropped_requests`` must be 0 on every arm — a handoff that cannot
+      land fails open to donor-local decode, it never loses the request;
+    - ``migrated_bytes_ratio_int8_vs_bf16`` must be <= 0.3 — the int8 pool
+      ships quantized payloads + per-page scales, so its wire bytes must
+      come in well under half the bf16 arm's.
+
+    Structural (parity and byte counts, not wall time), so it runs
+    off-TPU too.
+    """
+    doc = _load(os.path.join(bench_dir, "BENCH_http.json"))
+    run = ((doc or {}).get("detail") or {}).get("disagg_run")
+    if not run:
+        return []
+    failures = []
+    for dtype, arm in (run.get("runs") or {}).items():
+        if arm.get("token_parity") is not True:
+            failures.append(
+                f"disagg[{dtype}]: prefill->decode drain is not "
+                "token-identical to the single mixed scheduler — migration "
+                "must preserve the (uid, token_index) sampling stream exactly"
+            )
+        dropped = arm.get("dropped_requests", 0)
+        if dropped:
+            failures.append(
+                f"disagg[{dtype}]: {dropped} dropped request(s) — a failed "
+                "handoff must fail open to local decode, never vanish"
+            )
+    ratio = run.get("migrated_bytes_ratio_int8_vs_bf16")
+    if ratio is None:
+        failures.append(
+            "disagg: no migrated-bytes ratio recorded (bf16 arm migrated "
+            "zero bytes?) — the int8-vs-bf16 comparison needs both arms"
+        )
+    elif ratio > 0.3:
+        failures.append(
+            f"disagg: int8 migrated-bytes ratio {ratio:.3f} > 0.3x bf16 — "
+            "the quantized page payload is not paying for itself on the wire"
+        )
+    return failures
+
+
 def check_grouped_lora(bench_dir: str, tolerance: float) -> List[str]:
     """Grouped multi-tenant LoRA rule over ``detail.grouped_buckets`` in
     BENCH_lora.json: with every row on one adapter (G=1), the grouped
@@ -482,6 +537,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_packed(args.dir, args.tolerance)
         + check_autoscale(args.dir)
         + check_grouped_lora(args.dir, args.tolerance)
+        + check_disagg(args.dir)
     )
 
     rounds = real_rounds(args.dir)

@@ -62,6 +62,7 @@ _TIMELINE_KINDS = (
     "slo_burn_alert",
     "series_anomaly",
     "adapter_thrash",
+    "migration_failed",
 )
 
 
