@@ -81,6 +81,19 @@ relora_tpu/ops/lora_dispatch) per shape bucket, written to
 BENCH_LORA_ITERS, BENCH_LORA_DTYPE (f32|bf16).  Off-TPU the fused arm runs
 the pallas *interpreter* — orders of magnitude slower than XLA, reported for
 parity-debugging only; arm-vs-arm conclusions need the TPU run.
+
+``--mode compress`` runs the prune-retrain quality ladder
+(relora_tpu/compress, docs/compression.md): per sparsity level it reports
+the post-prune eval-loss delta, the LoRA-only retrain recovery, a
+synthetic-GLUE score of the pruned backbone, and the greedy accept rate +
+token parity of a pruned draft model speculating against its own dense base
+(``--spec model``).  Writes ``BENCH_compress.json`` and mirrors the
+model-draft entries into ``BENCH_http.json``'s ``detail.spec_runs``.  The
+gated numbers are structural, so the mode runs on any backend, CPU
+included.  Env: BENCH_COMPRESS_MODEL (default llama_9m),
+BENCH_COMPRESS_SPARSITIES, BENCH_COMPRESS_PRETRAIN_STEPS,
+BENCH_COMPRESS_RETRAIN_STEPS, BENCH_COMPRESS_GLUE_EPOCHS,
+BENCH_COMPRESS_SPEC_K.
 """
 
 from __future__ import annotations
@@ -1906,13 +1919,243 @@ def obs_overhead_main() -> None:
     print(json.dumps(result))
 
 
+def compress_main() -> None:
+    """--mode compress: the prune-retrain quality ladder (relora_tpu/compress)
+    over sparsity levels — post-prune eval-loss delta, LoRA-only retrain
+    recovery (PERP), a synthetic-GLUE probe of the pruned backbone, and the
+    model-draft accept rate of each pruned draft speculating against its own
+    dense base.  The numbers the gate reads are structural (loss deltas,
+    accept rates, token parity — not wall time), so the artifact is
+    meaningful off-TPU.  The model-draft entries are also merged into
+    BENCH_http.json's ``detail.spec_runs`` (keys ``model:<sparsity>``) so
+    the spec-decoding gate rule sees them next to the ngram sweep.
+
+    Env: BENCH_COMPRESS_MODEL (default llama_9m), BENCH_COMPRESS_SPARSITIES
+    ("0.0,0.25,0.5,0.75"), BENCH_COMPRESS_PRETRAIN_STEPS,
+    BENCH_COMPRESS_RETRAIN_STEPS, BENCH_COMPRESS_GLUE_EPOCHS,
+    BENCH_COMPRESS_SPEC_K, BENCH_COMPRESS_BATCH, BENCH_COMPRESS_SEQ."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    model_name = os.environ.get("BENCH_COMPRESS_MODEL", "llama_9m")
+    sparsities = [
+        float(s)
+        for s in os.environ.get(
+            "BENCH_COMPRESS_SPARSITIES", "0.0,0.25,0.5,0.75"
+        ).split(",")
+        if s.strip()
+    ]
+    pretrain_steps = int(os.environ.get("BENCH_COMPRESS_PRETRAIN_STEPS", "30"))
+    retrain_steps = int(os.environ.get("BENCH_COMPRESS_RETRAIN_STEPS", "20"))
+    glue_epochs = int(os.environ.get("BENCH_COMPRESS_GLUE_EPOCHS", "2"))
+    spec_k = int(os.environ.get("BENCH_COMPRESS_SPEC_K", "4"))
+    batch = int(os.environ.get("BENCH_COMPRESS_BATCH", "4"))
+    seq = int(os.environ.get("BENCH_COMPRESS_SEQ", "32"))
+    rank = int(os.environ.get("BENCH_COMPRESS_RANK", "8"))
+
+    from relora_tpu.compress.prune import apply_mask, magnitude_mask, sparsity_stats
+    from relora_tpu.config.model import load_model_config
+    from relora_tpu.core.relora import LoraSpec, merged_params, trainable_param_mask
+    from relora_tpu.eval.glue import GlueConfig, finetune
+    from relora_tpu.models.params_util import init_params
+    from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+    from relora_tpu.serve.scheduler import PagedContinuousBatchingScheduler, Request
+    from relora_tpu.train.losses import causal_lm_loss
+
+    cfg = load_model_config(model_name)
+    lspec = LoraSpec(r=rank, alpha=2 * rank)
+    family_cls = type(build_decode_model(cfg, cache_size=8))
+    model = family_cls(cfg, lora=lspec, dtype=jnp.float32, scan_layers=True)
+    params = init_params(model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    # successor-token data: next = (cur + 1) % vocab — a pattern the tiny
+    # model learns in a few dozen steps, so pruning has real loss to damage
+    # and LoRA retraining has real signal to recover it with
+    rs = np.random.RandomState(0)
+
+    def make_ids(n: int) -> np.ndarray:
+        start = rs.randint(1, cfg.vocab_size - 1, size=(n, 1))
+        return ((start + np.arange(seq)[None, :]) % cfg.vocab_size).astype(np.int32)
+
+    eval_ids = jnp.asarray(make_ids(16))
+
+    @jax.jit
+    def eval_loss(p) -> jax.Array:
+        logits = model.apply({"params": p}, eval_ids, deterministic=True)
+        loss, _ = causal_lm_loss(logits, eval_ids)
+        return loss
+
+    def make_step(tx):
+        @jax.jit
+        def step(p, opt_state, ids):
+            def lf(q):
+                logits = model.apply({"params": q}, ids, deterministic=True)
+                loss, _ = causal_lm_loss(logits, ids)
+                return loss
+
+            loss, grads = jax.value_and_grad(lf)(p)
+            updates, opt_state = tx.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        return step
+
+    # brief full-parameter "pretrain" so base magnitudes carry signal
+    pre_tx = optax.adam(1e-2)
+    pre_step = make_step(pre_tx)
+    opt_state = pre_tx.init(params)
+    for i in range(pretrain_steps):
+        params, opt_state, _ = pre_step(params, opt_state, jnp.asarray(make_ids(batch)))
+    dense_loss = float(eval_loss(params))
+
+    # PERP retrain: only the LoRA factors move, so base zeros stay zero
+    lora_mask = trainable_param_mask(params, lora_only=True)
+    ft_tx = optax.masked(optax.adam(1e-2), lora_mask)
+    ft_step = make_step(ft_tx)
+
+    # synthetic GLUE (the test_glue task: token at position 0 decides the
+    # label) — same data for every level, score differences are the prune
+    glue_rs = np.random.RandomState(1)
+
+    def glue_make(n):
+        ids = glue_rs.randint(3, 64, size=(n, 12)).astype(np.int32)
+        labels = glue_rs.randint(0, 2, size=n)
+        ids[:, 0] = np.where(labels == 1, 1, 2)
+        return ids, labels
+
+    g_train = glue_make(128)
+    g_eval = glue_make(64)
+    g_bs = 32
+    g_steps = len(g_train[0]) // g_bs
+
+    def glue_score(backbone) -> float:
+        def train_batches():
+            for i in range(g_steps):
+                yield g_train[0][i * g_bs:(i + 1) * g_bs], g_train[1][i * g_bs:(i + 1) * g_bs]
+
+        def eval_batches():
+            for i in range(0, len(g_eval[0]), g_bs):
+                yield g_eval[0][i:i + g_bs], g_eval[1][i:i + g_bs]
+
+        gcfg = GlueConfig(task="sst2", lr=5e-3, batch_size=g_bs, num_epochs=glue_epochs, seed=0)
+        metrics, _ = finetune(
+            cfg, gcfg, train_batches, eval_batches, g_steps,
+            pad_token_id=0, pretrained_backbone=backbone,
+        )
+        return metrics["accuracy"]
+
+    # draft accept-rate probe: a paged base engine speculating with the
+    # pruned draft, drained against a plain engine for greedy token parity
+    cache_size, page_size, chunk_size, probe_batch = 64, 8, 16, 2
+    probe_pages = 2 * probe_batch * (cache_size // page_size) + 1
+    probe_reqs = [
+        Request(uid=i, prompt=[(7 * i + j) % 97 + 1 for j in range(10)], max_new_tokens=8)
+        for i in range(4)
+    ]
+
+    def spec_probe(base_tree, draft_tree) -> dict:
+        kw = dict(
+            cache_size=cache_size, page_size=page_size,
+            num_pages=probe_pages, chunk_size=chunk_size,
+        )
+        plain_eng = InferenceEngine(cfg, base_tree, **kw)
+        plain = PagedContinuousBatchingScheduler(
+            plain_eng, max_batch=probe_batch, eos_id=-1, key=jax.random.PRNGKey(42)
+        ).run(list(probe_reqs))
+        spec_eng = InferenceEngine(cfg, base_tree, spec_k=spec_k, **kw)
+        spec_eng.load_draft_params(draft_tree)
+        sched = PagedContinuousBatchingScheduler(
+            spec_eng, max_batch=probe_batch, eos_id=-1,
+            key=jax.random.PRNGKey(42), spec="model",
+        )
+        drained = sched.run(list(probe_reqs))
+        stats = sched.spec_stats()
+        parity = len(drained) == len(plain) and all(
+            uid in drained and drained[uid].tokens == c.tokens
+            for uid, c in plain.items()
+        )
+        stats["token_parity"] = parity
+        return stats
+
+    levels = []
+    for level in sparsities:
+        mask = magnitude_mask(params, level)
+        stats = sparsity_stats(mask)
+        pruned = apply_mask(params, mask)
+        loss_pruned = float(eval_loss(pruned))
+        p, opt_state = pruned, ft_tx.init(pruned)
+        for i in range(retrain_steps):
+            p, opt_state, _ = ft_step(p, opt_state, jnp.asarray(make_ids(batch)))
+        loss_retrained = float(eval_loss(p))
+        # the base is the retrained model's own dense merge — deployment
+        # serves the trained checkpoint and exports the draft from that same
+        # checkpoint, so at sparsity 0.0 draft == base and accept is 1.0 by
+        # construction.  draft = merge, then re-apply the mask (merging folds
+        # BA back into pruned positions; the exported draft must be sparse)
+        base_tree = jax.tree_util.tree_map(np.asarray, merged_params(p, lspec))
+        draft_tree = jax.tree_util.tree_map(np.asarray, apply_mask(base_tree, mask))
+        spec_stats = spec_probe(base_tree, draft_tree)
+        levels.append({
+            "sparsity": level,
+            "actual_sparsity": round(stats["sparsity"], 4),
+            "loss_dense": round(dense_loss, 4),
+            "loss_pruned": round(loss_pruned, 4),
+            "loss_delta": round(loss_pruned - dense_loss, 4),
+            "loss_retrained": round(loss_retrained, 4),
+            "loss_recovered_delta": round(loss_retrained - dense_loss, 4),
+            "glue_score": round(glue_score(draft_tree), 4),
+            "spec": spec_stats,
+        })
+        print(json.dumps({"level": levels[-1]}))
+
+    result = {
+        "bench": "compress",
+        "metric": f"{model_name} prune-retrain ladder ({len(levels)} sparsity levels)",
+        "value": levels[-1]["spec"]["accept_rate"],
+        "unit": "accept_rate_at_max_sparsity",
+        "detail": {
+            "model": model_name,
+            "device": str(jax.devices()[0]),
+            "spec_k": spec_k,
+            "lora_rank": rank,
+            "pretrain_steps": pretrain_steps,
+            "retrain_steps": retrain_steps,
+            "baseline_eval_loss": round(dense_loss, 4),
+            "levels": levels,
+        },
+    }
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(repo, "BENCH_compress.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    # mirror the model-draft runs into the HTTP artifact's spec_runs block,
+    # keyed "model:<sparsity>", so check_spec sees model drafting next to
+    # the ngram sweep without rerunning the load bench
+    http_path = os.path.join(repo, "BENCH_http.json")
+    if os.path.exists(http_path):
+        try:
+            with open(http_path) as f:
+                http = json.load(f)
+            spec_runs = http.setdefault("detail", {}).setdefault("spec_runs", {})
+            for lv in levels:
+                spec_runs[f"model:{lv['sparsity']}"] = {
+                    **lv["spec"],
+                    "sparsity": lv["sparsity"],
+                }
+            with open(http_path, "w") as f:
+                json.dump(http, f, indent=2)
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"skipping BENCH_http.json spec_runs merge: {e}")
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
     import argparse
 
     _ap = argparse.ArgumentParser()
     _ap.add_argument(
         "--mode",
-        choices=["train", "decode", "lint", "lora_kernel", "attention", "serve_load", "autoscale", "obs_overhead"],
+        choices=["train", "decode", "lint", "lora_kernel", "attention", "serve_load", "autoscale", "obs_overhead", "compress"],
         default="train",
     )
     _ap.add_argument(
@@ -1942,6 +2185,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if _cli.mode == "attention":
         attention_main()
+        sys.exit(0)
+    if _cli.mode == "compress":
+        compress_main()
         sys.exit(0)
     if os.environ.get("BENCH_FORCE") != "1":
         platform, err = _probe_device()

@@ -60,6 +60,10 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "InferenceEngine.decode_paged",  # paged: every decode step
         "InferenceEngine.init_pool",
         "InferenceEngine._row_idx",  # adapter routing, once per prefill/decode
+        # model-drafted speculation: the draft forward runs per prefill
+        # chunk / per draft-proposal step, right inside the round
+        "InferenceEngine.draft_prefill_chunk",
+        "InferenceEngine.draft_decode_paged",
     ],
     # multi-tenant registry: acquire/release run inside the schedulers' admit
     # and retire passes, once per request per round.  Loads and evictions do
@@ -79,6 +83,8 @@ HOT_FUNCTIONS: Dict[str, List[str]] = {
         "PagedContinuousBatchingScheduler.step",  # one budgeted round
         "PagedContinuousBatchingScheduler._admit_pass",  # per round
         "PagedContinuousBatchingScheduler._prefill_pass",  # per round
+        # --spec model: K autoregressive draft forwards per decode round
+        "PagedContinuousBatchingScheduler._model_draft_pass",
         "ContinuousBatchingScheduler._acquire_adapter",  # per admitted request
         "ContinuousBatchingScheduler._release_adapter",  # per retired request
         # disaggregation seams that run on the model thread, inside the

@@ -1,0 +1,29 @@
+"""Compression subsystem: prune-retrain, magnitude-aware resets, draft export.
+
+Three pieces, each one seam deep into the existing stack:
+
+- :mod:`relora_tpu.compress.prune` — layer-wise magnitude pruning of the
+  *frozen base* (PERP, arXiv:2312.15230): mask construction (global /
+  per-matrix thresholds, structured N:M), mask application through the
+  merge/requant flow, and the checkpoint sidecar format.
+- :mod:`relora_tpu.compress.resets` — magnitude-informed A/B re-init at
+  ReLoRA resets ("The Primacy of Magnitude in Low-Rank Adaptation",
+  arXiv:2507.06558) behind the ``reset_init={random,magnitude}`` dial.
+- :mod:`relora_tpu.compress.draft` — export a pruned+merged checkpoint as
+  a servable *draft model* for ``--spec model`` speculative decoding.
+"""
+
+from relora_tpu.compress.prune import (  # noqa: F401
+    PruneMaskMismatchError,
+    apply_mask,
+    load_mask,
+    magnitude_mask,
+    mask_checksum,
+    save_mask,
+    sparsity_stats,
+)
+from relora_tpu.compress.draft import (  # noqa: F401
+    build_draft_params,
+    export_draft_checkpoint,
+)
+from relora_tpu.compress.resets import magnitude_a_init, make_reinit_fn  # noqa: F401

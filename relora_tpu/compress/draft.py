@@ -1,0 +1,169 @@
+"""Export a pruned+merged checkpoint as a servable draft model.
+
+The prune-retrain pipeline (PERP) leaves a training checkpoint whose frozen
+base is already sparse; this module turns it into a standalone checkpoint
+the serve path can load next to the full model for model-drafted
+speculative decoding (``serve.py --spec model --draft-checkpoint ...``).
+
+The export is just the serving restore (merge-verified) with the prune
+mask applied and re-saved through the normal checkpoint writer, so the
+output dir has everything ``restore_serving_params`` expects: an Orbax
+``state/`` tree, a size+crc32 manifest covering the ``prune_mask.npz`` /
+``prune_meta.json`` sidecars, and the mesh/partition-rule metadata — plus a
+``pruned`` block in the manifest metadata recording sparsity and the mask
+checksum.
+
+Because the draft shares the base model's architecture (same config, just
+sparser kernels), the serving engine can run it through the base's already
+compiled prefill/decode programs — loading a draft never recompiles.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Tuple, Union
+
+from relora_tpu.compress.prune import (
+    PruneMaskMismatchError,
+    _walk_prunable,
+    apply_mask,
+    load_mask,
+    magnitude_mask,
+    mask_checksum,
+    save_mask,
+    sparsity_stats,
+)
+
+PyTree = Any
+
+logger = logging.getLogger(__name__)
+
+
+def build_draft_params(
+    checkpoint_dir: str,
+    *,
+    sparsity: Optional[float] = None,
+    scope: str = "global",
+    nm: Union[str, Tuple[int, int], None] = None,
+) -> Tuple[PyTree, PyTree, dict]:
+    """Restore ``checkpoint_dir`` merged for serving and prune it.
+
+    Returns ``(pruned_params, mask, meta)``.  The mask comes from the
+    checkpoint's own ``prune_mask.npz`` sidecar when present (a
+    prune-retrain run — the LoRA factors were trained against exactly this
+    mask, so reusing it is the right call); otherwise it is computed here
+    at ``sparsity``/``nm`` over the merged kernels, using the unmerged
+    tree's LoRA paths to decide which modules are prunable.
+    """
+    from relora_tpu.train import checkpoint as ckpt
+
+    mask, meta = load_mask(checkpoint_dir)
+    params = ckpt.restore_serving_params(checkpoint_dir)
+    if mask is None:
+        if sparsity is None and nm is None:
+            raise ValueError(
+                f"{checkpoint_dir} has no prune_mask.npz sidecar and no "
+                "sparsity/nm was given — nothing to prune with"
+            )
+        host = ckpt.restore_params_host(checkpoint_dir)
+        paths = [path for path, _ in _walk_prunable(host)]
+        if not paths:
+            raise PruneMaskMismatchError(
+                f"{checkpoint_dir} has no LoRA factors to locate prunable "
+                "modules by — export from an unmerged training checkpoint, "
+                "or from one carrying a prune_mask.npz sidecar"
+            )
+        mask = magnitude_mask(
+            params, 0.0 if sparsity is None else sparsity,
+            scope=scope, nm=nm, paths=paths,
+        )
+        meta = {
+            "target_sparsity": sparsity,
+            "scope": scope,
+            "nm": nm,
+            "computed_at": "draft_export",
+        }
+    pruned = apply_mask(params, mask)
+    return pruned, mask, dict(meta or {})
+
+
+def export_draft_checkpoint(
+    checkpoint_dir: str,
+    out_dir: str,
+    *,
+    sparsity: Optional[float] = None,
+    scope: str = "global",
+    nm: Union[str, Tuple[int, int], None] = None,
+) -> str:
+    """Write a pruned+merged draft checkpoint under ``out_dir``; returns the
+    ``model_N`` path (N = the source checkpoint's update step).
+
+    The output passes ``verify_checkpoint`` and loads through
+    ``restore_serving_params`` — exactly what ``serve.py --draft-checkpoint``
+    and ``engine.load_draft_params`` consume.
+    """
+    from relora_tpu.parallel.mesh import current_mesh, mesh_metadata
+    from relora_tpu.train import checkpoint as ckpt
+
+    pruned, mask, mask_meta = build_draft_params(
+        checkpoint_dir, sparsity=sparsity, scope=scope, nm=nm
+    )
+    stats = sparsity_stats(mask)
+    try:
+        training_state = ckpt.load_training_state(checkpoint_dir)
+    except (OSError, ValueError):
+        training_state = {}
+    step = int(training_state.get("update_step", 0))
+    metadata = mesh_metadata(current_mesh())
+    metadata["pruned"] = {
+        "sparsity": round(stats["sparsity"], 6),
+        "mask_crc32": mask_checksum(mask),
+        "source_checkpoint": os.path.abspath(checkpoint_dir),
+    }
+    path = ckpt.save_checkpoint(
+        out_dir,
+        step,
+        {"params": pruned},
+        {**training_state, "draft_export": True},
+        manifest_metadata=metadata,
+    )
+    # the sidecar pair lands before the manifest fence below, so the
+    # manifest's size+crc32 walk covers it
+    save_mask(path, mask, mask_meta)
+    ckpt.wait_for_save()
+    logger.info(
+        f"draft export: {path} at {stats['sparsity']:.1%} sparsity "
+        f"(mask crc32 {metadata['pruned']['mask_crc32']})"
+    )
+    return path
+
+
+def main(argv=None) -> None:
+    """``python -m relora_tpu.compress.draft CKPT OUT [--sparsity S]``"""
+    import argparse
+
+    p = argparse.ArgumentParser(description=export_draft_checkpoint.__doc__)
+    p.add_argument("checkpoint", help="source checkpoint dir (model_N)")
+    p.add_argument("out_dir", help="output dir; the export lands in out_dir/model_N")
+    p.add_argument(
+        "--sparsity",
+        type=float,
+        default=None,
+        help="target sparsity when the source has no prune_mask.npz sidecar",
+    )
+    p.add_argument("--scope", choices=("global", "per_matrix"), default="global")
+    p.add_argument("--nm", default=None, help="structured N:M sparsity, e.g. 2:4")
+    args = p.parse_args(argv)
+    path = export_draft_checkpoint(
+        args.checkpoint,
+        args.out_dir,
+        sparsity=args.sparsity,
+        scope=args.scope,
+        nm=args.nm,
+    )
+    print(path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,340 @@
+"""Layer-wise magnitude pruning of the frozen base (PERP, arXiv:2312.15230).
+
+ReLoRA's merge step makes the frozen base a living artifact: every cycle
+folds the low-rank update into the kernel and re-draws the factors.  PERP's
+observation is that this is exactly the right moment to prune — magnitude-
+prune the merged base, then let the *next* cycle's LoRA factors (the only
+trainable weights) recover the damage.  The mask is computed **once**, at
+the first merge past ``prune_start_step``, and re-applied after every later
+merge so pruned positions stay exactly zero for the rest of the run.
+
+Mask format
+-----------
+A nested dict mirroring the params tree's module structure, holding a
+single boolean ``kernel``-shaped leaf (True = keep) at every pruned module
+and nothing anywhere else.  The same tree walks alongside ``params`` inside
+:func:`relora_tpu.core.relora.merge_and_reinit` (mask applied to the merged
+f32 values *before* requant — one quantization, no double-rounding) and is
+persisted as a checkpoint sidecar (``prune_mask.npz`` + ``prune_meta.json``)
+covered by the manifest's size+crc32 walk.
+
+Exact-zero invariance across storage formats:
+
+- dense (f32/bf16): ``0.0`` casts to ``0.0``;
+- int8: symmetric zero-point — code 0 dequantizes to exactly 0;
+- nf4: the codebook's index-7 level is exactly 0.0 and the midpoint encoder
+  maps 0 to it, so ``0 * bscale == 0.0`` regardless of double-quant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relora_tpu.core.relora import LORA_A
+
+PyTree = Any
+
+PRUNE_MASK_FILE = "prune_mask.npz"
+PRUNE_META_FILE = "prune_meta.json"
+
+_VALID_SCOPES = ("global", "per_matrix")
+
+
+class PruneMaskMismatchError(ValueError):
+    """A prune mask does not line up with the weight tree it is applied to
+    (missing module, extra module, or a shape mismatch) — named so callers
+    (export_hf --pruned, serve draft loading) can refuse loudly."""
+
+
+def parse_nm(nm: Union[str, Tuple[int, int], None]) -> Optional[Tuple[int, int]]:
+    """``"2:4"`` -> ``(2, 4)``; validates N < M, both positive."""
+    if nm is None:
+        return None
+    if isinstance(nm, str):
+        parts = nm.split(":")
+        if len(parts) != 2:
+            raise ValueError(f"nm must look like 'N:M', got {nm!r}")
+        n, m = (int(p) for p in parts)
+    else:
+        n, m = nm
+    if not (0 < n < m):
+        raise ValueError(f"N:M sparsity needs 0 < N < M, got {n}:{m}")
+    return n, m
+
+
+def _module_base(node: Dict[str, Any]) -> Optional[jax.Array]:
+    """The module's frozen base as an f32 dense array (dequantized when the
+    storage is int8/nf4), or None for lora_only modules with no base."""
+    if "kernel" in node:
+        return node["kernel"].astype(jnp.float32)
+    if "kernel_q" in node:
+        from relora_tpu.ops.quant import dequantize_int8
+
+        return dequantize_int8(node["kernel_q"], node["kernel_scale"])
+    if "kernel_codes" in node:
+        from relora_tpu.ops.quant import dequantize_nf4, nf4_leaves_from_module
+
+        return dequantize_nf4(nf4_leaves_from_module(node))
+    return None
+
+
+def _walk_prunable(params: PyTree, path: Tuple[str, ...] = ()):
+    """Yield ``(path, module_dict)`` for every LoRA-wrapped module that owns
+    a base kernel, in deterministic tree order (the same order
+    ``merge_and_reinit`` walks)."""
+    if not isinstance(params, dict):
+        return
+    if LORA_A in params:
+        if _module_base(params) is not None:
+            yield path, params
+        return
+    for k in params:
+        yield from _walk_prunable(params[k], path + (k,))
+
+
+def _module_at(params: PyTree, path: Tuple[str, ...]) -> Optional[Dict[str, Any]]:
+    """The module dict at ``path``, or None when the path does not resolve."""
+    node = params
+    for k in path:
+        if not isinstance(node, dict) or k not in node:
+            return None
+        node = node[k]
+    return node if isinstance(node, dict) else None
+
+
+def _nm_mask(mags: jax.Array, n: int, m: int) -> jax.Array:
+    """Structured N:M keep-mask: within every group of M consecutive rows
+    along the input (reduction) axis, keep the N largest magnitudes."""
+    *lead, in_f, out_f = mags.shape
+    if in_f % m:
+        raise ValueError(f"N:M pruning needs in_features % M == 0, got {in_f} % {m}")
+    groups = mags.reshape(*lead, in_f // m, m, out_f)
+    # rank of each element within its group (0 = smallest)
+    order = jnp.argsort(groups, axis=-2)
+    ranks = jnp.argsort(order, axis=-2)
+    keep = ranks >= (m - n)
+    return keep.reshape(mags.shape)
+
+
+def magnitude_mask(
+    params: PyTree,
+    sparsity: float,
+    *,
+    scope: str = "global",
+    nm: Union[str, Tuple[int, int], None] = None,
+    paths: Optional[list] = None,
+) -> PyTree:
+    """Build a keep-mask over every frozen base kernel.
+
+    ``scope="global"`` ranks magnitudes across all prunable kernels with one
+    threshold; ``"per_matrix"`` applies the sparsity level to each kernel
+    independently.  ``nm`` switches to structured N:M sparsity (N kept per
+    group of M along the input axis) and ignores ``sparsity``/``scope``.
+
+    ``paths`` overrides module discovery with an explicit list of module
+    paths — how the draft exporter prunes an already-*merged* tree (no
+    ``lora_a`` leaves to walk) using the paths recorded from the unmerged
+    training checkpoint.
+
+    Magnitudes are taken on the *dequantized* base for int8/nf4 storage, so
+    the mask means the same thing whatever the storage format.
+    """
+    if scope not in _VALID_SCOPES:
+        raise ValueError(f"scope must be one of {_VALID_SCOPES}, got {scope!r}")
+    nm_t = parse_nm(nm)
+    if nm_t is None and not 0.0 <= sparsity < 1.0:
+        raise ValueError(f"sparsity must be in [0, 1), got {sparsity}")
+
+    if paths is not None:
+        modules = []
+        for path in paths:
+            path = tuple(path)
+            mod = _module_at(params, path)
+            if mod is None or _module_base(mod) is None:
+                raise PruneMaskMismatchError(
+                    f"requested prune path {'/'.join(path)} has no base kernel "
+                    "in this weight tree"
+                )
+            modules.append((path, mod))
+    else:
+        modules = list(_walk_prunable(params))
+    if not modules:
+        raise ValueError("no prunable modules found (is this a LoRA param tree?)")
+
+    if nm_t is not None:
+        n, m = nm_t
+        return _build_tree(
+            {path: _nm_mask(jnp.abs(_module_base(mod)), n, m) for path, mod in modules}
+        )
+
+    if sparsity == 0.0:
+        return _build_tree(
+            {path: jnp.ones(_module_base(mod).shape, bool) for path, mod in modules}
+        )
+
+    mags = {path: jnp.abs(_module_base(mod)) for path, mod in modules}
+    if scope == "global":
+        flat = jnp.concatenate([m.ravel() for m in mags.values()])
+        thresh = jnp.quantile(flat, sparsity)
+        masks = {path: m > thresh for path, m in mags.items()}
+    else:
+        masks = {path: m > jnp.quantile(m.ravel(), sparsity) for path, m in mags.items()}
+    return _build_tree(masks)
+
+
+def _build_tree(masks: Dict[Tuple[str, ...], jax.Array]) -> PyTree:
+    """``{path: array}`` -> nested dict with a ``kernel`` leaf per module."""
+    tree: Dict[str, Any] = {}
+    for path, arr in masks.items():
+        node = tree
+        for k in path:
+            node = node.setdefault(k, {})
+        node["kernel"] = arr
+    return tree
+
+
+def _mask_items(mask: PyTree, path: Tuple[str, ...] = ()):
+    """Yield ``(path, keep_array)`` for every mask leaf, deterministic order."""
+    if not isinstance(mask, dict):
+        return
+    for k in sorted(mask):
+        v = mask[k]
+        if k == "kernel" and not isinstance(v, dict):
+            yield path, v
+        elif isinstance(v, dict):
+            yield from _mask_items(v, path + (k,))
+
+
+def apply_mask(params: PyTree, mask: PyTree) -> PyTree:
+    """Zero the pruned positions of every masked base kernel.
+
+    Validates the mask against the tree first: a module the mask names that
+    the tree lacks, or a shape mismatch, raises
+    :class:`PruneMaskMismatchError` (nothing partially applied).  Quantized
+    bases go dequant -> mask -> requant; requantization is idempotent on
+    already-quantized values, so repeated application is safe (the hot-swap
+    and merge-cycle invariance tests rely on this).
+
+    The walk is path-directed (not LoRA-directed), so the same mask applies
+    to the unmerged training tree and to a merged serving/draft tree whose
+    ``lora_a`` leaves are gone.
+    """
+    by_path = dict(_mask_items(mask))
+    missing = sorted(
+        path
+        for path in by_path
+        if (mod := _module_at(params, path)) is None or _module_base(mod) is None
+    )
+    if missing:
+        raise PruneMaskMismatchError(
+            f"prune mask names modules absent from the weight tree: "
+            f"{['/'.join(p) for p in missing]}"
+        )
+
+    def walk(node, path=()):
+        if not isinstance(node, dict):
+            return node
+        keep = by_path.get(path)
+        if keep is not None:
+            base = _module_base(node)
+            if base.shape != keep.shape:
+                raise PruneMaskMismatchError(
+                    f"prune mask shape {tuple(keep.shape)} != kernel shape "
+                    f"{tuple(base.shape)} at {'/'.join(path)}"
+                )
+            masked = jnp.where(keep, base, 0.0)
+            out = dict(node)
+            if "kernel" in node:
+                out["kernel"] = masked.astype(node["kernel"].dtype)
+            elif "kernel_q" in node:
+                from relora_tpu.ops.quant import quantize_int8
+
+                out["kernel_q"], out["kernel_scale"] = quantize_int8(masked)
+            else:
+                from relora_tpu.ops.quant import nf4_leaves_to_module, quantize_nf4
+
+                out.update(
+                    nf4_leaves_to_module(
+                        quantize_nf4(
+                            masked,
+                            double_quant=node["kernel_bscale_q"].dtype == jnp.int8,
+                        )
+                    )
+                )
+            return out
+        return {k: walk(v, path + (k,)) for k, v in node.items()}
+
+    return walk(params)
+
+
+def sparsity_stats(mask: PyTree) -> Dict[str, Any]:
+    """Fraction pruned, overall and per module (host scalars, for logging
+    and the prune_meta sidecar)."""
+    per_module = {}
+    pruned = total = 0
+    for path, keep in _mask_items(mask):
+        k = np.asarray(keep)
+        per_module["/".join(path)] = float(1.0 - k.mean())
+        pruned += int(k.size - k.sum())
+        total += int(k.size)
+    return {
+        "sparsity": pruned / total if total else 0.0,
+        "pruned": pruned,
+        "total": total,
+        "per_module": per_module,
+    }
+
+
+def mask_checksum(mask: PyTree) -> int:
+    """crc32 over the packed mask bits in deterministic path order — the
+    identity recorded in checkpoint manifests and export sidecars."""
+    crc = 0
+    for path, keep in _mask_items(mask):
+        crc = zlib.crc32("/".join(path).encode(), crc)
+        crc = zlib.crc32(np.packbits(np.asarray(keep, dtype=bool)).tobytes(), crc)
+    return crc
+
+
+def save_mask(dir_path: str, mask: PyTree, meta: Optional[dict] = None) -> dict:
+    """Write the sidecar pair into a checkpoint dir; returns the meta dict
+    (stats + checksum + whatever the caller passed)."""
+    arrays = {
+        "/".join(path): np.asarray(keep, dtype=bool) for path, keep in _mask_items(mask)
+    }
+    full_meta = dict(meta or {})
+    full_meta.update(sparsity_stats(mask))
+    full_meta["mask_crc32"] = mask_checksum(mask)
+    os.makedirs(dir_path, exist_ok=True)
+    np.savez_compressed(os.path.join(dir_path, PRUNE_MASK_FILE), **arrays)
+    with open(os.path.join(dir_path, PRUNE_META_FILE), "w") as f:
+        json.dump(full_meta, f, indent=2)
+    return full_meta
+
+
+def load_mask(dir_path: str) -> Tuple[Optional[PyTree], Optional[dict]]:
+    """Read the sidecar pair back; ``(None, None)`` when the checkpoint was
+    never pruned.  Verifies the recorded crc32 against the reloaded bits."""
+    mask_path = os.path.join(dir_path, PRUNE_MASK_FILE)
+    if not os.path.exists(mask_path):
+        return None, None
+    with np.load(mask_path) as z:
+        masks = {tuple(name.split("/")): jnp.asarray(z[name]) for name in z.files}
+    mask = _build_tree(masks)
+    meta = None
+    meta_path = os.path.join(dir_path, PRUNE_META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        want = meta.get("mask_crc32")
+        if want is not None and mask_checksum(mask) != want:
+            raise PruneMaskMismatchError(
+                f"prune mask at {dir_path} fails its recorded crc32 ({want})"
+            )
+    return mask, meta

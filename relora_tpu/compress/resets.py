@@ -1,0 +1,65 @@
+"""Magnitude-informed A/B re-initialization at ReLoRA resets.
+
+"The Primacy of Magnitude in Low-Rank Adaptation" (arXiv:2507.06558) argues
+the blind kaiming re-draw at every ReLoRA reset wastes the information the
+merged base already carries: input rows with large weight magnitude are the
+rows whose updates matter, so the fresh A should put its variance there.
+
+The dial is ``reset_init``:
+
+- ``"random"`` — today's behavior, byte-for-byte: plain
+  :func:`relora_tpu.core.relora.kaiming_uniform` (the default ``a_init=None``
+  path of ``merge_and_reinit`` draws from the identical key sequence).
+- ``"magnitude"`` — the kaiming draw re-scaled per input row by the merged
+  kernel's row-magnitude profile, RMS-normalized so the overall init
+  variance matches the random draw in expectation.  B stays zero either
+  way, so the delta starts at 0 and the loss curve is continuous across
+  the reset regardless of the dial.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from relora_tpu.core.relora import kaiming_uniform
+
+#: signature of a pluggable A-init: (key, a_shape, merged_base_f32) -> array
+AInitFn = Callable[[jax.Array, Tuple[int, ...], Optional[jax.Array]], jax.Array]
+
+_EPS = 1e-8
+
+
+def magnitude_a_init(
+    key: jax.Array, shape: Tuple[int, ...], merged: Optional[jax.Array]
+) -> jax.Array:
+    """Weight-magnitude-aligned A init.
+
+    ``shape`` is the lora_a shape ``(..., in, r)``; ``merged`` is the f32
+    merged (and, under pruning, masked) base kernel ``(..., in, out)``.
+    Each input row of the kaiming draw is scaled by that row's RMS weight
+    magnitude, normalized so the mean squared scale is 1 — the init keeps
+    kaiming's overall energy but concentrates it on high-magnitude rows
+    (pruned-away rows get exactly zero signal).
+    """
+    base = kaiming_uniform(key, shape)
+    if merged is None:
+        return base
+    row = jnp.sqrt(jnp.mean(jnp.square(merged), axis=-1, keepdims=True))  # (..., in, 1)
+    rms = jnp.sqrt(jnp.mean(jnp.square(row), axis=-2, keepdims=True))
+    return base * (row / jnp.maximum(rms, _EPS))
+
+
+def make_reinit_fn(reset_init: str) -> Optional[AInitFn]:
+    """``reset_init`` dial -> the ``a_init`` argument of ``merge_and_reinit``.
+
+    ``"random"`` maps to None (the built-in kaiming path — byte-for-byte
+    today's behavior), ``"magnitude"`` to :func:`magnitude_a_init`.
+    """
+    if reset_init == "random":
+        return None
+    if reset_init == "magnitude":
+        return magnitude_a_init
+    raise ValueError(f"reset_init must be 'random' or 'magnitude', got {reset_init!r}")

@@ -81,6 +81,21 @@ class TrainingConfig:
     optimizer_magnitude_pruning: float = 0.0
     force_keep_original: bool = False
 
+    # --- compression (relora_tpu/compress; PERP prune-retrain) ---
+    # Base-weight magnitude pruning, applied at ReLoRA merges: at the first
+    # merge past prune_start_step the mask is computed from the merged base
+    # (fixed from then on) and re-applied after every later merge, so each
+    # cycle runs merge -> prune -> re-init A/B -> continue and the LoRA
+    # factors retrain around the holes.  0.0 disables pruning entirely.
+    prune_sparsity: float = 0.0
+    prune_scope: str = "global"  # global | per_matrix magnitude threshold
+    prune_nm: Optional[str] = None  # structured "N:M" (overrides sparsity/scope)
+    prune_start_step: int = 0  # first update step eligible to compute the mask
+    # A/B re-draw flavor at ReLoRA resets (compress/resets.py):
+    # "random" = historical kaiming draw (byte-for-byte), "magnitude" =
+    # weight-magnitude-aligned init from the merged base
+    reset_init: str = "random"
+
     # --- optimization ---
     optimizer: str = "adam"
     lr: float = 1e-4
@@ -283,6 +298,28 @@ class TrainingConfig:
                 f"got {self.remat_policy!r}"
             )
 
+        if not 0 <= self.prune_sparsity < 1:
+            raise ValueError(f"prune_sparsity must be in [0, 1), got {self.prune_sparsity}")
+        if self.prune_scope not in ("global", "per_matrix"):
+            raise ValueError(
+                f"prune_scope must be 'global' or 'per_matrix', got {self.prune_scope!r}"
+            )
+        if self.prune_nm is not None:
+            from relora_tpu.compress.prune import parse_nm
+
+            parse_nm(self.prune_nm)  # raises on malformed "N:M"
+        if self.reset_init not in ("random", "magnitude"):
+            raise ValueError(
+                f"reset_init must be 'random' or 'magnitude', got {self.reset_init!r}"
+            )
+        if self.prune_start_step < 0:
+            raise ValueError("prune_start_step must be >= 0")
+        if (self.prune_sparsity or self.prune_nm) and not self.use_peft:
+            raise ValueError(
+                "base-weight pruning retrains through the LoRA factors; "
+                "it requires use_peft=true (PERP regime)"
+            )
+
         if self.log_every < 1:
             raise ValueError("log_every must be >= 1")
         if self.save_retries < 0:
@@ -305,6 +342,11 @@ class TrainingConfig:
         return self
 
     # ------------------------------------------------------------------
+    @property
+    def prune_enabled(self) -> bool:
+        """True when the prune-retrain pipeline is active (either dial)."""
+        return bool(self.prune_sparsity or self.prune_nm)
+
     @property
     def optimizer_reset_mode(self) -> Optional[str]:
         """Which of the three mutually exclusive reset modes is active."""

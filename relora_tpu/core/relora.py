@@ -229,7 +229,14 @@ def lora_delta(module: dict, spec: LoraSpec) -> jax.Array:
     return delta * _effective_scale(module, spec)
 
 
-def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
+def merge_and_reinit(
+    params: PyTree,
+    rng: jax.Array,
+    spec: LoraSpec,
+    *,
+    a_init=None,
+    mask: Optional[PyTree] = None,
+) -> PyTree:
     """Pure ReLoRA reset: fold every module's ``A @ B * scale`` into its frozen
     kernel, re-draw A (kaiming uniform), zero B (and scaling, if trainable).
 
@@ -239,6 +246,17 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
     dtypes.  Intended use::
 
         merged = jax.jit(partial(merge_and_reinit, spec=spec), donate_argnums=0)(params, rng)
+
+    Compression hooks (relora_tpu/compress):
+
+    - ``a_init`` — pluggable A re-init ``(key, a_shape, merged_f32) -> array``
+      receiving the merged (and masked) base, so magnitude-informed inits can
+      read the weight profile.  ``None`` is the historical kaiming path,
+      byte-for-byte (identical key sequence, identical draw).
+    - ``mask`` — a prune keep-mask tree (nested dict with a boolean
+      ``kernel`` leaf per pruned module, see compress/prune.py) applied to
+      the merged f32 values *before* requant/cast, so pruned positions land
+      exactly zero in every storage format with a single quantization.
     """
     # Deterministic per-module keys: count lora modules in tree order first.
     modules = []
@@ -254,11 +272,12 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
     keys = jax.random.split(rng, max(1, len(modules)))
     key_iter = iter(range(len(modules)))
 
-    def walk(node):
+    def walk(node, mask_node):
         if not isinstance(node, dict):
             return node
+        sub = mask_node if isinstance(mask_node, dict) else {}
         if LORA_A not in node:
-            return {k: walk(v) for k, v in node.items()}
+            return {k: walk(v, sub.get(k)) for k, v in node.items()}
         key = keys[next(key_iter)]
         if "kernel" not in node and "kernel_q" not in node and "kernel_codes" not in node:
             # lora_only module: nothing to merge into — skipped entirely,
@@ -271,6 +290,7 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
             from relora_tpu.ops.quant import dequantize_int8, quantize_int8
 
             merged = dequantize_int8(node["kernel_q"], node["kernel_scale"]) + lora_delta(node, spec)
+            merged = _masked(merged, sub)
             out["kernel_q"], out["kernel_scale"] = quantize_int8(merged)
         elif "kernel_codes" in node:
             # nf4 base: dequant -> add -> requant, double-quant preserved
@@ -283,6 +303,7 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
             )
 
             merged = dequantize_nf4(nf4_leaves_from_module(node)) + lora_delta(node, spec)
+            merged = _masked(merged, sub)
             requant = quantize_nf4(
                 merged, double_quant=node["kernel_bscale_q"].dtype == jnp.int8
             )
@@ -290,14 +311,25 @@ def merge_and_reinit(params: PyTree, rng: jax.Array, spec: LoraSpec) -> PyTree:
         else:
             kernel = node["kernel"]
             merged = kernel.astype(jnp.float32) + lora_delta(node, spec)
+            merged = _masked(merged, sub)
             out["kernel"] = merged.astype(kernel.dtype)
-        out[LORA_A] = kaiming_uniform(key, node[LORA_A].shape).astype(node[LORA_A].dtype)
+        a_shape = node[LORA_A].shape
+        fresh_a = kaiming_uniform(key, a_shape) if a_init is None else a_init(key, a_shape, merged)
+        out[LORA_A] = fresh_a.astype(node[LORA_A].dtype)
         out[LORA_B] = jnp.zeros_like(node[LORA_B])
         if spec.trainable_scaling and LORA_S in node:
             out[LORA_S] = jnp.zeros_like(node[LORA_S])
         return out
 
-    return walk(params)
+    return walk(params, mask)
+
+
+def _masked(merged: jax.Array, mask_node: dict) -> jax.Array:
+    """Apply a module's prune keep-mask to its merged f32 kernel, if any."""
+    keep = mask_node.get("kernel") if isinstance(mask_node, dict) else None
+    if keep is None or isinstance(keep, dict):
+        return merged
+    return jnp.where(keep, merged, 0.0)
 
 
 def merged_params(params: PyTree, spec: LoraSpec) -> PyTree:

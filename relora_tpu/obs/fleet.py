@@ -455,6 +455,11 @@ class FleetCollector:
         disp_real = None
         for name, value in flat.items():
             values[name] = value
+            # drafting-mode gauge (1 = model draft, 0 = ngram), aliased to
+            # its bare name so the replica comparison's spec_acc mode suffix
+            # reads one series whatever the registry namespace
+            if name.endswith("spec_mode_model"):
+                values["spec_mode_model"] = value
             if name.endswith("_total") or "_total." in name:
                 prev = self._prev_counters.get((source, name))
                 self._prev_counters[(source, name)] = (now, value)

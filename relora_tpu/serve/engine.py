@@ -345,6 +345,10 @@ class InferenceEngine:
             shardings = param_shardings(mesh, specs)
             params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         self.params = params
+        # optional second tree for model-drafted speculation (--spec model):
+        # same shapes/dtypes/shardings as params, installed via
+        # load_draft_params, fed through the SAME compiled paged programs
+        self.draft_params: Optional[PyTree] = None
 
         def prefill_fn(p, ids, positions, cache, adapter_idx):
             logits, variables = self.model.apply(
@@ -714,6 +718,75 @@ class InferenceEngine:
         self.params = self._reload(self.params, fresh)
         # surface transfer/execution errors here, not on the next decode
         jax.block_until_ready(self.params)
+
+    # -- draft model (model-drafted speculative decoding) --------------------
+
+    def load_draft_params(self, new_params: PyTree) -> None:
+        """Install a second (draft) param tree next to the base — the
+        pruned+merged checkpoint ``--spec model`` proposes from.
+
+        Same validation and placement as ``reload_params`` (every live leaf
+        needs a same-shape twin, dtypes cast host-side, shards placed on the
+        live leaf's sharding) but with NO donation: base and draft stay
+        resident together, sharing the one page pool, tokenizer, and — the
+        point — the already-compiled paged programs.  The params argument of
+        every paged jit is traced, and the draft tree presents the identical
+        abstract signature, so draft forwards replay the base's executables:
+        zero new compiles in steady state, pinned by CompileWatcher."""
+        self._require_paged()
+        if self.adapter_slots:
+            raise ValueError(
+                "draft models and adapter slots are mutually exclusive: the "
+                "draft tree is a merged base with no tenant slabs (serve the "
+                "draft from a dedicated replica instead)"
+            )
+        fresh = self._prepare_reload_tree(self.params, new_params)
+        self.draft_params = jax.tree_util.tree_map(jnp.asarray, fresh)
+        jax.block_until_ready(self.draft_params)
+
+    def _require_draft(self):
+        if self.draft_params is None:
+            raise ValueError("no draft model loaded (call load_draft_params first)")
+
+    def draft_prefill_chunk(
+        self, ids: jax.Array, start: int, pool: PyTree, block_table
+    ) -> Tuple[jax.Array, PyTree]:
+        """``prefill_chunk`` through the draft weights: same chunk, same
+        positions, the draft's own block table (draft pages are allocated
+        alongside the base's at admission).  Replays the compiled
+        prefill_chunk program — the traced param tree is the only change."""
+        self._require_paged()
+        self._require_draft()
+        B, T = ids.shape
+        positions = jnp.asarray(start, jnp.int32) + jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
+        )
+        return self._prefill_chunk(
+            self.draft_params,
+            jnp.asarray(ids),
+            positions,
+            pool,
+            jnp.asarray(block_table, jnp.int32),
+            self._row_idx(None, B),
+        )
+
+    def draft_decode_paged(
+        self, pool: PyTree, token: jax.Array, pos: jax.Array, block_tables
+    ) -> Tuple[jax.Array, PyTree]:
+        """One autoregressive draft-proposal step (``--spec model``): the
+        draft model's ``decode_paged`` over the draft block tables.  Null
+        rows follow the same convention as the base step — all-null tables
+        and ``pos = cache_size`` clip their writes into the null page."""
+        self._require_paged()
+        self._require_draft()
+        return self._decode_paged(
+            self.draft_params,
+            pool,
+            jnp.asarray(token),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            self._row_idx(None, token.shape[0]),
+        )
 
     def _row_idx(self, adapter_idx, rows: int) -> jax.Array:
         """Normalize an optional per-row adapter index to a concrete (rows,)

@@ -655,6 +655,9 @@ class _PagedSlot(_Slot):
     decoding: bool = False  # first token sampled; joins the decode batch
     seq: int = 0  # admission order; chunk scheduling is oldest-first
     migrating: bool = False  # handoff to a decode-pool peer is in flight
+    # spec="model": the draft model's own page run (same worst-case size as
+    # the base's), allocated at admission from the one shared pool
+    draft_pages: List[int] = dataclasses.field(default_factory=list)
 
 
 class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
@@ -733,13 +736,35 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             raise ValueError(
                 f"role must be 'prefill', 'decode', or 'mixed', got {role!r}"
             )
-        if spec not in ("off", "ngram"):
-            raise ValueError(f"spec must be 'off' or 'ngram', got {spec!r}")
+        if spec not in ("off", "ngram", "model"):
+            raise ValueError(
+                f"spec must be 'off', 'ngram', or 'model', got {spec!r}"
+            )
         if spec != "off" and getattr(engine, "spec_k", 0) < 1:
             raise ValueError(
-                "spec='ngram' needs an engine built with spec_k >= 1 "
+                f"spec={spec!r} needs an engine built with spec_k >= 1 "
                 "(the verify window compiles at (batch, spec_k+1))"
             )
+        if spec == "model":
+            if getattr(engine, "draft_params", None) is None:
+                raise ValueError(
+                    "spec='model' needs a draft model: call "
+                    "engine.load_draft_params(...) before building the scheduler"
+                )
+            if packed:
+                raise ValueError(
+                    "spec='model' is incompatible with packed=True (the draft "
+                    "proposal loop runs on the per-row decode path)"
+                )
+            if role != "mixed":
+                raise ValueError(
+                    "spec='model' needs role='mixed': draft KV pages cannot "
+                    "migrate between disaggregated peers"
+                )
+            # base and draft prefill must stay in lockstep, so prefix-cache
+            # page sharing (which skips base prefill work the draft still
+            # needs) is disabled in model-drafted mode
+            prefix_cache = False
         self._spec = spec
         self._spec_drafted = 0  # cumulative drafted tokens (counter)
         self._spec_accepted = 0  # cumulative accepted drafted tokens (counter)
@@ -781,6 +806,12 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         # per-row decode block tables: NULL rows for free / still-prefilling
         # slots, so their garbage decode write lands in the null page
         self._tables = np.zeros((self.max_batch, engine.block_table_width), np.int32)
+        # spec="model": per-row draft-model block tables, same null-row
+        # convention as ``_tables`` (free rows stay all-null so the draft
+        # loop's garbage writes land in the null page)
+        self._draft_tables = np.zeros(
+            (self.max_batch, engine.block_table_width), np.int32
+        )
         # the packed step's table matrix: every slot's table (W plus the
         # trailing null column) and a final all-null pad row that padding
         # tokens' row_map points at — maintained from admission so packed
@@ -877,12 +908,15 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                         self._prefix_fetch_tried.clear()
                     self._prefix_fetch_tried.add(req.uid)
                     shared_pages, shared_tokens = self._fetch_prefix(req)
-            fresh = self.allocator.alloc(need - len(shared_pages))
+            # spec="model": the draft model keeps its own KV pages in the one
+            # shared pool — admission allocates both runs or neither
+            draft_need = need if self._spec == "model" else 0
+            fresh = self.allocator.alloc(need - len(shared_pages) + draft_need)
             if fresh is None and self.prefix_cache is not None:
                 # under pressure: drop idle prefix entries (LRU) and retry —
                 # entries shared with live requests survive via refcounts
-                self.prefix_cache.evict(need - len(shared_pages))
-                fresh = self.allocator.alloc(need - len(shared_pages))
+                self.prefix_cache.evict(need - len(shared_pages) + draft_need)
+                fresh = self.allocator.alloc(need - len(shared_pages) + draft_need)
             if fresh is None:
                 # allocator exhausted: stay queued rather than reject; pages
                 # free as decoding requests retire (docs/operations.md)
@@ -892,6 +926,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 return
             self._pending.popleft()
             t_admit = time.monotonic()
+            base_fresh = fresh[: need - len(shared_pages)]
+            draft_pages = fresh[need - len(shared_pages):]
             self._slots[slot_idx] = _PagedSlot(
                 request=req,
                 pos=0,
@@ -900,21 +936,23 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 t_first=t_admit,  # overwritten when the first token lands
                 deadline=deadline,
                 span=None,  # decode span opens at first token
-                pages=shared_pages + fresh,
+                pages=shared_pages + base_fresh,
                 shared_pages=len(shared_pages),
                 prefill_progress=shared_tokens,
                 seq=self._admit_seq,
                 adapter_slot=adapter_slot,
+                draft_pages=draft_pages,
             )
             self._admit_seq += 1
             # decode row stays NULL until this slot starts decoding
             self._tokens[slot_idx] = 0
             self._positions[slot_idx] = 0
             self._tables[slot_idx, :] = 0
+            self._draft_tables[slot_idx, :] = 0
             # the packed table row is live from admission: prefill tokens
             # route through it the same round they are admitted
             self._ptables[slot_idx, :] = 0
-            pages = shared_pages + fresh
+            pages = shared_pages + base_fresh
             self._ptables[slot_idx, : len(pages)] = pages
             self._adapter_row[slot_idx] = adapter_slot
 
@@ -955,6 +993,15 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 adapter_idx=[slot.adapter_slot],
             )
             self._count_dispatch(chunk, n_real)
+            if self._spec == "model":
+                # the draft model prefills the same chunk into its own page
+                # run, so base and draft KV stay in lockstep position-wise
+                draft_table = np.zeros((1, self.engine.block_table_width), np.int32)
+                draft_table[0, : len(slot.draft_pages)] = slot.draft_pages
+                _, self._pool = self.engine.draft_prefill_chunk(
+                    ids, start, self._pool, draft_table
+                )
+                self._count_dispatch(chunk, n_real)
             slot.prefill_progress = start + n_real
             if slot.prefill_progress >= L:
                 first = self.engine._sample(
@@ -980,6 +1027,8 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
         self._tokens[slot_idx] = first_id
         self._positions[slot_idx] = L
         self._tables[slot_idx, : len(slot.pages)] = slot.pages
+        if slot.draft_pages:
+            self._draft_tables[slot_idx, : len(slot.draft_pages)] = slot.draft_pages
         self._emit_token(req.uid, first_id, 0)
         self._finish_if_done(slot_idx, finished)
         self._maybe_migrate(slot_idx)
@@ -1306,6 +1355,47 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 drafts[slot_idx] = d
         return drafts
 
+    def _model_draft_pass(self) -> Dict[int, List[int]]:
+        """spec="model": the draft model proposes up to ``spec_k`` tokens per
+        decoding row by running k batched ``(batch, 1)`` autoregressive decode
+        steps over its own page run, chaining greedy (argmax) proposals on
+        device and pulling the whole proposal matrix to the host once at the
+        end.  Rows past their own draft budget go null mid-loop (all-null
+        table, pos 0) so their garbage writes land in the null page.  The
+        same budget rule as the ngram drafter applies (remaining minus one),
+        so the verify window never writes past the admission allocation."""
+        spec_k = self.engine.spec_k
+        B = self.max_batch
+        ks = np.zeros(B, np.int32)
+        eligible: List[int] = []
+        for slot_idx, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding or not slot.request.spec:
+                continue
+            k = min(spec_k, slot.request.max_new_tokens - len(slot.tokens) - 1)
+            if k <= 0:
+                continue
+            eligible.append(slot_idx)
+            ks[slot_idx] = k
+        if not eligible:
+            return {}
+        k_max = int(ks.max())
+        cur = jnp.asarray(self._tokens)[:, None]
+        proposals = []
+        for step in range(k_max):
+            live = ks > step
+            positions = np.where(live, self._positions + step, 0).astype(np.int32)
+            tables = np.where(live[:, None], self._draft_tables, 0).astype(np.int32)
+            logits, self._pool = self.engine.draft_decode_paged(
+                self._ensure_pool(), cur, positions[:, None], tables
+            )
+            self._count_dispatch(B, int(live.sum()))
+            cur = jnp.argmax(logits, axis=-1).astype(jnp.int32).reshape(-1, 1)
+            proposals.append(cur)
+        stacked = np.asarray(jnp.concatenate(proposals, axis=1))  # one host pull
+        return {
+            i: [int(t) for t in stacked[i, : int(ks[i])]] for i in eligible
+        }
+
     def _verify_round(self, drafts: Dict[int, List[int]], finished: List[Completion]) -> None:
         """One ``(batch, spec_k+1)`` verify forward over every decoding row,
         then the host-side accept walk.  Window row 0 carries the pending
@@ -1442,7 +1532,12 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             return finished  # pure-prefill round (or idle)
 
         t_decode = time.monotonic()
-        drafts = self._draft_pass() if self._spec == "ngram" else {}
+        if self._spec == "ngram":
+            drafts = self._draft_pass()
+        elif self._spec == "model":
+            drafts = self._model_draft_pass()
+        else:
+            drafts = {}
         n_drafted = sum(len(d) for d in drafts.values())
         with self.tracer.span(
             "decode_step",
@@ -1549,6 +1644,9 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                     "spec_accept_rate",
                     self._spec_accepted / max(self._spec_drafted, 1),
                 )
+                self.obs_registry.set_gauge(
+                    "spec_mode_model", 1.0 if self._spec == "model" else 0.0
+                )
                 self.obs_registry.inc("spec_drafted_total", by=0)
                 self.obs_registry.inc("spec_accepted_total", by=0)
         record = None
@@ -1579,6 +1677,9 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 record["serve/spec_accepted_total"] = self._spec_accepted
                 record["serve/spec_accept_rate"] = round(
                     self._spec_accepted / max(self._spec_drafted, 1), 4
+                )
+                record["serve/spec_mode_model"] = (
+                    1 if self._spec == "model" else 0
                 )
         self._adapter_gauges(record)
         if record is not None:
@@ -1812,7 +1913,11 @@ class PagedContinuousBatchingScheduler(ContinuousBatchingScheduler):
             # refs keep registered pages alive for the next hit)
             self.allocator.decref(slot.pages)
             slot.pages = []
+        if slot.draft_pages:
+            self.allocator.decref(slot.draft_pages)
+            slot.draft_pages = []
         self._tables[slot_idx, :] = 0
+        self._draft_tables[slot_idx, :] = 0
         self._ptables[slot_idx, :] = 0
         self._tokens[slot_idx] = 0
         self._positions[slot_idx] = 0

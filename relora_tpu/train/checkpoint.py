@@ -115,7 +115,11 @@ _file_crc32 = integrity.file_crc32
 
 def _walk_state_files(path: str) -> dict:
     """{relpath: {size, crc32}} for every file under ``path/state/`` plus the
-    sibling JSON files the resume path depends on."""
+    sibling JSON files the resume path depends on (and the prune-mask
+    sidecar pair, when the checkpoint carries one — pruned zeros are load-
+    bearing, so the mask is integrity-checked like the weights)."""
+    from relora_tpu.compress.prune import PRUNE_MASK_FILE, PRUNE_META_FILE
+
     files = {}
     state_path = os.path.join(path, STATE_SUBDIR)
     for root, _, names in os.walk(state_path):
@@ -123,7 +127,7 @@ def _walk_state_files(path: str) -> dict:
             full = os.path.join(root, name)
             rel = os.path.relpath(full, path)
             files[rel] = {"size": os.path.getsize(full), "crc32": _file_crc32(full)}
-    for name in (TRAINING_STATE_FILE, RELORA_CONFIG_FILE):
+    for name in (TRAINING_STATE_FILE, RELORA_CONFIG_FILE, PRUNE_MASK_FILE, PRUNE_META_FILE):
         full = os.path.join(path, name)
         if os.path.exists(full):
             files[name] = {"size": os.path.getsize(full), "crc32": _file_crc32(full)}

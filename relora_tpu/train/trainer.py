@@ -411,16 +411,25 @@ class Trainer:
             else None
         )
         if self.lora_spec is not None:
-            spec = self.lora_spec
-            # out_shardings pins the merged tree to the same placement as the
-            # donated input: without it a tp/fsdp-sharded param tree could
-            # come back replicated after a merge-and-reinit, silently turning
-            # every later train step into a resharding collective
-            self._merge_fn = jax.jit(
-                functools.partial(merge_and_reinit, spec=spec),
-                donate_argnums=0,
-                out_shardings=self.shardings,
-            )
+            # prune-retrain state (relora_tpu/compress): the keep-mask is
+            # computed once at the first merge past prune_start_step, then
+            # baked into the merge program so every later cycle re-zeroes the
+            # pruned positions before requant.  Resume restores the sidecar
+            # so the holes survive a process restart.
+            self._prune_mask = None
+            self._prune_meta: Optional[dict] = None
+            if self.resume_dir and cfg.prune_enabled:
+                from relora_tpu.compress import prune as compress_prune
+
+                mask, meta = compress_prune.load_mask(self.resume_dir)
+                if mask is not None:
+                    self._prune_mask = mask
+                    self._prune_meta = meta
+                    logger.info(
+                        f"Restored prune mask from {self.resume_dir} "
+                        f"(sparsity {meta.get('sparsity', 0) if meta else 0:.3f})"
+                    )
+            self._build_merge_fn()
         self._reset_fn = jax.jit(
             functools.partial(
                 reset_optimizer_state,
@@ -494,6 +503,78 @@ class Trainer:
         if cfg.save_dir and jax.process_index() == 0:
             os.makedirs(cfg.save_dir, exist_ok=True)
             cfg.save(os.path.join(cfg.save_dir, "training_config.yaml"))
+
+    # ------------------------------------------------------------------
+    def _build_merge_fn(self) -> None:
+        """(Re)compile the merge-and-reinit program with the current prune
+        mask and reset-init dial baked in.
+
+        Rebuilt at most twice per run (construction + the first prune event)
+        — merge cadence, never the hot step.  out_shardings pins the merged
+        tree to the same placement as the donated input: without it a
+        tp/fsdp-sharded param tree could come back replicated after a
+        merge-and-reinit, silently turning every later train step into a
+        resharding collective."""
+        from relora_tpu.compress.resets import make_reinit_fn
+
+        self._merge_fn = jax.jit(
+            functools.partial(
+                merge_and_reinit,
+                spec=self.lora_spec,
+                a_init=make_reinit_fn(self.cfg.reset_init),
+                mask=self._prune_mask,
+            ),
+            donate_argnums=0,
+            out_shardings=self.shardings,
+        )
+
+    def _maybe_compute_prune_mask(self) -> None:
+        """First prune event: derive the fixed keep-mask from the just-merged
+        base, zero the pruned positions in place, and rebake the merge
+        program so every later cycle re-applies the mask before requant."""
+        cfg = self.cfg
+        if (
+            self._prune_mask is not None
+            or not cfg.prune_enabled
+            or self.update_step < cfg.prune_start_step
+        ):
+            return
+        from relora_tpu.compress import prune as compress_prune
+
+        t0 = time.time()
+        self._prune_mask = magnitude = compress_prune.magnitude_mask(
+            self.state.params,
+            cfg.prune_sparsity,
+            scope=cfg.prune_scope,
+            nm=cfg.prune_nm,
+        )
+        stats = compress_prune.sparsity_stats(magnitude)
+        self._prune_meta = {
+            "target_sparsity": cfg.prune_sparsity,
+            "scope": cfg.prune_scope,
+            "nm": cfg.prune_nm,
+            "computed_at_step": self.update_step,
+        }
+        with self.mesh:
+            masked = jax.jit(
+                functools.partial(compress_prune.apply_mask, mask=self._prune_mask),
+                donate_argnums=0,
+                out_shardings=self.shardings,
+            )(self.state.params)
+        self.state = self.state.replace(params=masked)
+        jax.block_until_ready(self.state.params)
+        self._build_merge_fn()
+        self.metrics.event(
+            "prune_mask_computed",
+            step=self.update_step,
+            sparsity=stats["sparsity"],
+            mask_crc32=compress_prune.mask_checksum(magnitude),
+        )
+        logger.info(
+            f"Prune mask computed at update {self.update_step}: "
+            f"{stats['sparsity']*100:.2f}% of base weights zeroed "
+            f"({time.time() - t0:.2f}s)"
+        )
 
     # ------------------------------------------------------------------
     def _restore_state(self, path: str) -> PyTree:
@@ -987,6 +1068,10 @@ class Trainer:
                                 )
                             )
                             jax.block_until_ready(self.state.params)
+                            # PERP prune-retrain: first eligible merge fixes
+                            # the mask (later merges re-apply it inside
+                            # _merge_fn before requant)
+                            self._maybe_compute_prune_mask()
                         logger.info(
                             f"LoRA merge #{self.n_lora_restarts} at update {self.update_step} "
                             f"took {time.time() - t0:.2f}s"
@@ -1244,6 +1329,14 @@ class Trainer:
         )
         cfg.skip_batches |= new_skips
         self.state = self._normalize_placement(self._restore_state(target))
+        if self.lora_spec is not None and cfg.prune_enabled:
+            # the rollback target may predate the prune event: resync the
+            # mask (or its absence) from the target's sidecar so the merge
+            # program matches the restored weights
+            from relora_tpu.compress import prune as compress_prune
+
+            self._prune_mask, self._prune_meta = compress_prune.load_mask(target)
+            self._build_merge_fn()
         self.update_step = ts["update_step"]
         self.global_step = ts["global_step"]
         self.tokens_seen = ts["tokens_seen"]
@@ -1307,5 +1400,11 @@ class Trainer:
             logger.error(f"Checkpoint save at step {self.update_step} abandoned: {e}")
             self.metrics.event("save_failed", step=self.update_step, error=str(e))
             return ""
+        if getattr(self, "_prune_mask", None) is not None and jax.process_index() == 0:
+            # mask sidecar rides in the checkpoint dir (and its manifest's
+            # file walk): resume and the serving/export paths read it back
+            from relora_tpu.compress import prune as compress_prune
+
+            compress_prune.save_mask(path, self._prune_mask, self._prune_meta)
         ckpt.delete_old_checkpoints(self.cfg.save_dir, self.cfg.keep_checkpoints)
         return path

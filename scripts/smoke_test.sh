@@ -1265,4 +1265,114 @@ EOF
 kill -TERM "$DG_SUP_PID"
 wait "$DG_SUP_PID"   # exit 0 = rolling drain across all three roles
 
+echo "=== 17. compression: prune-retrain, draft export, --spec model parity vs 9b ==="
+# (a) prune mid-training: ReLoRA from the stage-1 warmup fixes the keep-mask
+# at the first merge past prune_start_step, then every later cycle re-zeroes
+# the holes before requant and retrains the fresh factors around them
+python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --warmed_up_model "$WORK/full/model_8" \
+    --prune_sparsity 0.5 --prune_scope per_matrix --prune_start_step 2 \
+    --reset_init magnitude \
+    --num_training_steps 24 --save_every 8 --save_dir "$WORK/prune"
+grep -q "prune_mask_computed" "$WORK/prune/metrics.jsonl"
+[ -f "$WORK/prune/model_24/prune_mask.npz" ]   # sidecar rides the checkpoint
+[ -f "$WORK/prune/model_24/prune_meta.json" ]
+
+# (b) resume the retrain cycle: autoresume restores the sidecar mask (no
+# recompute — the event count stays 1) and training continues through
+# another merge with the holes intact
+python main.py "${common[@]}" --lr 5e-3 --use_peft true --relora 8 --cycle_length 8 \
+    --scheduler cosine_restarts --restart_warmup_steps 2 \
+    --prune_sparsity 0.5 --prune_scope per_matrix --prune_start_step 2 \
+    --reset_init magnitude \
+    --num_training_steps 32 --save_every 8 --save_dir "$WORK/prune" \
+    --autoresume true
+[ "$(grep -c prune_mask_computed "$WORK/prune/metrics.jsonl")" = 1 ]
+[ -f "$WORK/prune/model_32/prune_mask.npz" ]
+python - "$WORK/prune/model_32" <<'EOF'
+# the stored base kernels stay exactly zero on the pruned positions across
+# prune -> retrain -> resume -> merge (the factors are dense, the base is not)
+import sys
+import numpy as np
+from relora_tpu.compress import prune
+from relora_tpu.train.checkpoint import restore_serving_params
+mask, meta = prune.load_mask(sys.argv[1])
+assert mask is not None and meta["sparsity"] > 0.4, meta
+# draft-export the resumed checkpoint: the sidecar mask is reused verbatim
+out = __import__("relora_tpu.compress.draft", fromlist=["export_draft_checkpoint"])
+path = out.export_draft_checkpoint(sys.argv[1], sys.argv[1] + "_draft")
+params = restore_serving_params(path)
+checked = 0
+for mpath, keep in prune._mask_items(mask):
+    mod = prune._module_at(params, mpath)
+    w = np.asarray(mod["kernel"], np.float32)
+    assert not np.any(w[~np.asarray(keep)]), mpath
+    checked += 1
+assert checked > 0
+print(f"prune-retrain OK: {meta['sparsity']*100:.1f}% sparsity exact-zero in {checked} modules")
+EOF
+
+# (c) export a light draft from the 9b checkpoint and serve it as the
+# --spec model drafter: greedy output must replay the 9b tokens exactly
+# (the parity contract — a pruned draft can only lower acceptance, never
+# change what the server says)
+python -m relora_tpu.compress.draft "$WORK/relora/model_40" "$WORK/draft" \
+    --sparsity 0.3 --scope per_matrix
+rm -f "$WORK/mspec_port"
+python serve.py --checkpoint "$WORK/relora/model_40" --model_config llama_9m \
+    --port 0 --port-file "$WORK/mspec_port" --max-batch 2 --max-queue 4 \
+    --cache-size 64 --max-new-tokens 6 --eos-id -1 \
+    --paged --page-size 8 --chunk-size 16 --spec model --spec-k 4 \
+    --draft-checkpoint "$WORK/draft/model_40" --run-dir "$WORK/mspec_run" &
+MSPEC_PID=$!
+for _ in $(seq 300); do [ -s "$WORK/mspec_port" ] && break; sleep 0.2; done
+[ -s "$WORK/mspec_port" ] || { echo "model-spec server never wrote its port"; kill "$MSPEC_PID"; exit 1; }
+python - "$(cat "$WORK/mspec_port")" "$WORK/paged_tokens.json" <<'EOF'
+import json, sys, urllib.request
+port = sys.argv[1]
+import time, urllib.error
+deadline = time.time() + 600
+while True:  # cold replica: healthz is 503 "warming" until compile warmup completes
+    try:
+        health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+    except urllib.error.HTTPError as e:
+        health = json.load(e)
+    if health["status"] == "ok":
+        break
+    assert health["status"] == "warming" and time.time() < deadline, health
+    time.sleep(0.5)
+spec = health["paging"]["spec"]
+assert spec["mode"] == "model" and spec["k"] == 4, spec
+
+def generate(prompt):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": 6}).encode(),
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        events = [line[len(b"data: "):] for line in resp if line.startswith(b"data: ")]
+    final = json.loads(events[-2])
+    assert final["finish_reason"] == "length" and len(final["tokens"]) == 6, final
+    return final["tokens"]
+
+# the 9b prompts again: greedy model-drafted decode must produce exactly
+# the tokens the non-speculative paged server produced
+want = json.load(open(sys.argv[2]))
+long_prompt = [(i % 100) + 1 for i in range(40)]
+got = generate(long_prompt)
+assert got == want, f"model-drafted decode diverged: {got} != {want}"
+generate([1, 2, 3])
+health = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=30))
+spec = health["paging"]["spec"]
+assert spec["drafted"] > 0, spec  # the model drafter always proposes
+metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+assert "relora_serve_spec_mode_model 1" in metrics, metrics
+assert "relora_serve_spec_drafted_total" in metrics, metrics
+print("model-spec HTTP OK:", got, "| spec:", spec)
+EOF
+kill -TERM "$MSPEC_PID"
+wait "$MSPEC_PID"
+grep -q "serve/spec_mode_model" "$WORK/mspec_run/metrics.jsonl"
+
 echo "SMOKE OK"

@@ -179,19 +179,29 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument(
         "--spec",
-        choices=("off", "ngram"),
+        choices=("off", "ngram", "model"),
         default="off",
         help="paged: speculative decoding — 'ngram' drafts continuations by "
-        "prompt lookup over each request's own context and verifies K per "
-        "step in one forward; greedy output stays token-identical "
-        "(docs/serving.md)",
+        "prompt lookup over each request's own context; 'model' runs a "
+        "pruned draft model (--draft-checkpoint) autoregressively for K "
+        "proposals; both verify K per step in one forward and greedy "
+        "output stays token-identical (docs/serving.md, "
+        "docs/compression.md)",
     )
     p.add_argument(
         "--spec-k",
         type=int,
         default=4,
         help="speculative: drafted tokens per verify step (compiled window "
-        "is spec-k+1 wide; only meaningful with --spec ngram)",
+        "is spec-k+1 wide; only meaningful with --spec ngram/model)",
+    )
+    p.add_argument(
+        "--draft-checkpoint",
+        default=None,
+        help="--spec model: a pruned+merged draft checkpoint dir (model_N, "
+        "from relora_tpu.compress.draft / export_hf --pruned) with the "
+        "same architecture as the base; loads next to the base weights "
+        "and shares the one KV page pool",
     )
     p.add_argument("--no-scan", action="store_true", help="checkpoint was trained with scan_layers=false")
     p.add_argument(
@@ -366,11 +376,37 @@ def main(argv=None) -> int:
     paged_kwargs = {}
     if args.paged:
         # default pool: every slot at full length simultaneously, + null page
-        num_pages = args.num_pages or (
-            args.max_batch * (cache_size // args.page_size) + 1
-        )
+        # (--spec model doubles the per-slot run: admission reserves a second
+        # worst-case page run for the draft model's KV)
+        slot_pages = cache_size // args.page_size
+        if args.spec == "model":
+            slot_pages *= 2
+        num_pages = args.num_pages or (args.max_batch * slot_pages + 1)
         if args.spec != "off" and args.spec_k < 1:
             raise SystemExit(f"--spec {args.spec} needs --spec-k >= 1, got {args.spec_k}")
+        if args.spec == "model":
+            if not args.draft_checkpoint:
+                raise SystemExit(
+                    "--spec model needs --draft-checkpoint (a pruned+merged "
+                    "draft export; see docs/compression.md)"
+                )
+            if args.packed:
+                raise SystemExit(
+                    "--spec model is incompatible with --packed (the draft "
+                    "proposal loop runs on the per-row decode path)"
+                )
+            if args.role != "mixed":
+                raise SystemExit(
+                    "--spec model needs --role mixed: draft KV pages cannot "
+                    "migrate between disaggregated peers"
+                )
+            if args.adapter_dir:
+                raise SystemExit(
+                    "--spec model is incompatible with --adapter-dir (draft "
+                    "models and adapter slots share the reload plumbing)"
+                )
+        elif args.draft_checkpoint:
+            raise SystemExit("--draft-checkpoint only applies with --spec model")
         paged_kwargs = dict(
             page_size=args.page_size,
             num_pages=num_pages,
@@ -423,6 +459,11 @@ def main(argv=None) -> int:
         adapter_slots=adapter_slots,
         **paged_kwargs,
     )
+    if args.spec == "model":
+        # the draft shares the engine's compiled prefill/decode programs
+        # (identical abstract signature) and the one KV page pool
+        logger.info(f"restoring draft model {args.draft_checkpoint}")
+        engine.load_draft_params(restore_serving_params(args.draft_checkpoint))
     key = jax.random.PRNGKey(args.seed)
 
     adapter_registry = None

@@ -1,0 +1,583 @@
+"""Compression subsystem (relora_tpu/compress): magnitude pruning of the
+frozen base, magnitude-aware ReLoRA resets, and the pruned draft model for
+``--spec model`` speculative decoding.
+
+The two contracts under test:
+
+- **Mask invariance**: once the prune mask exists, pruned positions are
+  exactly ``0.0`` — through ``apply_mask`` in every storage format (dense /
+  int8 / nf4: requant is idempotent on exact zeros), through repeated
+  ``merge_and_reinit`` cycles with live LoRA factors (the merge re-applies
+  the mask before requant), through LoRA-only retraining steps, and through
+  the serving engine's ``reload_params`` hot swap.
+- **The parity oracle**: a greedy drain through ``spec="model"`` (the
+  pruned draft proposing, the base verifying) must be token-identical to
+  the non-speculative paged drain — acceptance is argmax match against the
+  base's own logits, so the draft can only change *how fast* tokens commit,
+  never *which* tokens.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from relora_tpu.compress.prune import (
+    PruneMaskMismatchError,
+    apply_mask,
+    load_mask,
+    magnitude_mask,
+    mask_checksum,
+    parse_nm,
+    save_mask,
+    sparsity_stats,
+)
+from relora_tpu.compress.resets import magnitude_a_init, make_reinit_fn
+from relora_tpu.config.model import ModelConfig
+from relora_tpu.core.relora import (
+    LoraSpec,
+    kaiming_uniform,
+    merge_and_reinit,
+    merged_params,
+    trainable_param_mask,
+)
+from relora_tpu.models.params_util import init_params
+from relora_tpu.ops.quant import (
+    dequantize_int8,
+    dequantize_nf4,
+    nf4_leaves_from_module,
+    nf4_leaves_to_module,
+    quantize_int8,
+    quantize_nf4,
+)
+from relora_tpu.serve.engine import InferenceEngine, build_decode_model
+from relora_tpu.serve.scheduler import PagedContinuousBatchingScheduler, Request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.compress]
+
+TINY_LLAMA = ModelConfig(
+    family="llama",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+)
+TINY_NEOX = ModelConfig(
+    family="neox",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=160,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_sequence_length=64,
+    rotary_pct=0.25,
+)
+
+SPEC = LoraSpec(r=4, alpha=32)
+
+
+def make_params(rng=0, in_dim=16, out_dim=24, r=4):
+    """A hand-built LoRA tree with all three base storage formats side by
+    side (dense f32, int8, nf4) plus non-prunable bystanders."""
+    ks = jax.random.split(jax.random.PRNGKey(rng), 8)
+
+    def lora(i):
+        return {
+            "lora_a": jax.random.normal(ks[i], (in_dim, r)) * 0.1,
+            "lora_b": jax.random.normal(ks[i + 1], (r, out_dim)) * 0.1,
+        }
+
+    dense = jax.random.normal(ks[0], (in_dim, out_dim)) * 0.1
+    q, scale = quantize_int8(jax.random.normal(ks[1], (in_dim, out_dim)))
+    codes = nf4_leaves_to_module(
+        quantize_nf4(jax.random.normal(ks[2], (in_dim, out_dim)))
+    )
+    return {
+        "embed": {"embedding": jax.random.normal(ks[3], (32, in_dim))},
+        "layer": {
+            "q_proj": {"kernel": dense, **lora(2)},
+            "k_proj": {"kernel_q": q, "kernel_scale": scale, **lora(4)},
+            "v_proj": {**codes, **lora(6)},
+            "norm": {"scale": jnp.ones((in_dim,))},
+        },
+    }
+
+
+def dequant_base(mod):
+    if "kernel" in mod:
+        return np.asarray(mod["kernel"], np.float32)
+    if "kernel_q" in mod:
+        return np.asarray(dequantize_int8(mod["kernel_q"], mod["kernel_scale"]))
+    return np.asarray(dequantize_nf4(nf4_leaves_from_module(mod)))
+
+
+MODULES = ("q_proj", "k_proj", "v_proj")
+
+
+# -- mask construction --------------------------------------------------------
+
+
+def test_magnitude_mask_scopes():
+    params = make_params()
+    per = magnitude_mask(params, 0.5, scope="per_matrix")
+    # per-matrix: every module lands the target sparsity independently
+    for name in MODULES:
+        frac = 1.0 - np.asarray(per["layer"][name]["kernel"]).mean()
+        assert frac == pytest.approx(0.5, abs=0.05), name
+    glob = magnitude_mask(params, 0.5, scope="global")
+    assert sparsity_stats(glob)["sparsity"] == pytest.approx(0.5, abs=0.05)
+    # global: one threshold ranks the dense 0.1-scale module against the
+    # unit-scale quantized ones, so ITS sparsity is far above the target
+    dense_frac = 1.0 - np.asarray(glob["layer"]["q_proj"]["kernel"]).mean()
+    assert dense_frac > 0.9
+    # sparsity 0.0 is the identity mask
+    ones = magnitude_mask(params, 0.0)
+    assert sparsity_stats(ones)["sparsity"] == 0.0
+
+
+def test_nm_structured_mask():
+    params = make_params()
+    mask = magnitude_mask(params, 0.0, nm="2:4")
+    for name in MODULES:
+        keep = np.asarray(mask["layer"][name]["kernel"])
+        groups = keep.reshape(-1, 4, keep.shape[-1])
+        # exactly N kept in every group of M along the input axis
+        np.testing.assert_array_equal(groups.sum(axis=1), 2)
+        # and they are the N largest magnitudes of the group
+        mags = np.abs(dequant_base(params["layer"][name])).reshape(
+            -1, 4, keep.shape[-1]
+        )
+        kept = np.where(groups, mags, np.inf).min(axis=1)
+        dropped = np.where(~groups, mags, -np.inf).max(axis=1)
+        assert (kept >= dropped).all(), name
+    with pytest.raises(ValueError, match="N:M"):
+        parse_nm("4:2")
+    with pytest.raises(ValueError, match="in_features % M"):
+        magnitude_mask(make_params(in_dim=10), 0.0, nm="2:4")
+
+
+def test_mask_construction_guards():
+    params = make_params()
+    with pytest.raises(ValueError, match="scope"):
+        magnitude_mask(params, 0.5, scope="per_tensor")
+    with pytest.raises(ValueError, match="sparsity"):
+        magnitude_mask(params, 1.0)
+    with pytest.raises(ValueError, match="no prunable"):
+        magnitude_mask({"layer": {"norm": {"scale": jnp.ones(4)}}}, 0.5)
+    # explicit paths: a path with no base kernel fails loudly
+    with pytest.raises(PruneMaskMismatchError, match="embed"):
+        magnitude_mask(params, 0.5, paths=[("embed",)])
+
+
+# -- exact-zero application ---------------------------------------------------
+
+
+def test_apply_mask_exact_zero_all_storages():
+    params = make_params()
+    mask = magnitude_mask(params, 0.5, scope="per_matrix")
+    pruned = apply_mask(params, mask)
+    for name in MODULES:
+        keep = np.asarray(mask["layer"][name]["kernel"])
+        vals = dequant_base(pruned["layer"][name])
+        assert (vals[~keep] == 0.0).all(), f"{name}: pruned positions not exact zero"
+        assert (vals[keep] != 0.0).any(), name
+    # dense kept positions are untouched (no requant round trip)
+    keep_q = np.asarray(mask["layer"]["q_proj"]["kernel"])
+    np.testing.assert_array_equal(
+        np.asarray(pruned["layer"]["q_proj"]["kernel"])[keep_q],
+        np.asarray(params["layer"]["q_proj"]["kernel"])[keep_q],
+    )
+    # LoRA factors and bystanders pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(pruned["layer"]["q_proj"]["lora_a"]),
+        np.asarray(params["layer"]["q_proj"]["lora_a"]),
+    )
+    # requant is idempotent on already-masked values: second application is
+    # byte-identical (the hot-swap and merge-cycle invariance rely on this)
+    again = apply_mask(pruned, mask)
+    for a, b in zip(jax.tree_util.tree_leaves(again), jax.tree_util.tree_leaves(pruned)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_apply_mask_named_errors():
+    params = make_params()
+    ghost = {"layer": {"o_proj": {"kernel": jnp.ones((4, 4), bool)}}}
+    with pytest.raises(PruneMaskMismatchError, match="o_proj"):
+        apply_mask(params, ghost)
+    bad_shape = {"layer": {"q_proj": {"kernel": jnp.ones((4, 4), bool)}}}
+    with pytest.raises(PruneMaskMismatchError, match="q_proj"):
+        apply_mask(params, bad_shape)
+
+
+# -- the full prune-retrain cycle ---------------------------------------------
+
+
+def test_pruned_zeros_survive_merge_retrain_cycles():
+    """The PERP loop: merge -> prune -> re-init A/B -> retrain, three times
+    over.  Pruned base positions must be exactly zero after every merge in
+    every storage format, even though the LoRA factors between merges are
+    dense (their delta lands on pruned positions and must be re-zeroed)."""
+    params = make_params()
+    mask = magnitude_mask(params, 0.5, scope="per_matrix")
+    params = apply_mask(params, mask)
+
+    # LoRA-only retraining: optax.masked freezes the base, so steps between
+    # merges cannot touch the zeros (the optimizer half of the invariant)
+    tx = optax.masked(optax.adam(1e-2), trainable_param_mask(params, lora_only=True))
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def retrain_step(p, s):
+        # differentiate w.r.t. the float LoRA factors only (the int8/nf4
+        # base leaves are not valid grad inputs); everything else gets a
+        # zero cotangent, which the masked optimizer ignores anyway
+        def loss(ab):
+            base = p["layer"]["q_proj"]["kernel"] + (ab[0] @ ab[1]) * SPEC.scale
+            return jnp.sum(jnp.square(base @ jnp.ones((base.shape[-1], 1))))
+
+        mod = p["layer"]["q_proj"]
+        ga, gb = jax.grad(loss)((mod["lora_a"], mod["lora_b"]))
+        grads = jax.tree_util.tree_map(jnp.zeros_like, p)
+        grads["layer"]["q_proj"]["lora_a"] = ga
+        grads["layer"]["q_proj"]["lora_b"] = gb
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s
+
+    for cycle in range(3):
+        for _ in range(2):
+            params, opt_state = retrain_step(params, opt_state)
+        # the LoRA delta is dense here — the merge must re-zero the holes
+        params = merge_and_reinit(
+            params, jax.random.PRNGKey(cycle), SPEC, mask=mask
+        )
+        opt_state = tx.init(params)  # ReLoRA optimizer reset
+        for name in MODULES:
+            keep = np.asarray(mask["layer"][name]["kernel"])
+            vals = dequant_base(params["layer"][name])
+            assert (vals[~keep] == 0.0).all(), f"cycle {cycle} {name}"
+        # the cycle continues: fresh A, zero B
+        assert float(jnp.abs(params["layer"]["q_proj"]["lora_b"]).max()) == 0.0
+        assert float(jnp.abs(params["layer"]["q_proj"]["lora_a"]).max()) > 0.0
+
+
+# -- reset_init dial ----------------------------------------------------------
+
+
+def test_make_reinit_fn_dial():
+    assert make_reinit_fn("random") is None  # the byte-for-byte kaiming path
+    assert make_reinit_fn("magnitude") is magnitude_a_init
+    with pytest.raises(ValueError, match="reset_init"):
+        make_reinit_fn("xavier")
+
+
+def test_random_reset_is_byte_identical():
+    """reset_init='random' must not perturb today's behavior: same key, same
+    draw, every leaf byte-for-byte."""
+    params = make_params()
+    key = jax.random.PRNGKey(3)
+    legacy = merge_and_reinit(params, key, SPEC)
+    dialed = merge_and_reinit(
+        params, key, SPEC, a_init=make_reinit_fn("random"), mask=None
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(legacy), jax.tree_util.tree_leaves(dialed)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_magnitude_a_init_shape_determinism_and_profile():
+    key = jax.random.PRNGKey(11)
+    shape = (16, 4)
+    merged = jnp.concatenate(
+        [jnp.zeros((8, 24)), jax.random.normal(key, (8, 24))], axis=0
+    )
+    a1 = magnitude_a_init(key, shape, merged)
+    a2 = magnitude_a_init(key, shape, merged)
+    assert a1.shape == shape
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))  # deterministic
+    # zero-magnitude (pruned-away) input rows get exactly zero init signal
+    assert float(jnp.abs(a1[:8]).max()) == 0.0
+    assert float(jnp.abs(a1[8:]).max()) > 0.0
+    # no profile -> plain kaiming
+    np.testing.assert_array_equal(
+        np.asarray(magnitude_a_init(key, shape, None)),
+        np.asarray(kaiming_uniform(key, shape)),
+    )
+    # RMS normalization keeps the overall init energy at kaiming's scale
+    uniform = jnp.ones((16, 24))
+    np.testing.assert_allclose(
+        np.asarray(magnitude_a_init(key, shape, uniform)),
+        np.asarray(kaiming_uniform(key, shape)),
+        rtol=1e-6,
+    )
+
+
+def test_merge_with_magnitude_init_keeps_delta_zero():
+    """The dial changes only A: merged kernels identical to the random path
+    and B zero, so the model function is continuous across the reset
+    whatever the dial."""
+    params = make_params()
+    key = jax.random.PRNGKey(5)
+    mask = magnitude_mask(params, 0.5, scope="per_matrix")
+    rand = merge_and_reinit(params, key, SPEC, mask=mask)
+    mag = merge_and_reinit(
+        params, key, SPEC, a_init=make_reinit_fn("magnitude"), mask=mask
+    )
+    for name in MODULES:
+        np.testing.assert_array_equal(
+            dequant_base(rand["layer"][name]), dequant_base(mag["layer"][name])
+        )
+        assert float(jnp.abs(mag["layer"][name]["lora_b"]).max()) == 0.0
+    # pruned input rows of the merged base got zero A signal
+    keep = np.asarray(mask["layer"]["q_proj"]["kernel"])
+    dead_rows = ~keep.any(axis=-1)
+    if dead_rows.any():
+        a = np.asarray(mag["layer"]["q_proj"]["lora_a"])
+        assert (a[dead_rows] == 0.0).all()
+
+
+# -- sidecar round trip -------------------------------------------------------
+
+
+def test_mask_sidecar_roundtrip(tmp_path):
+    params = make_params()
+    mask = magnitude_mask(params, 0.5, scope="per_matrix")
+    meta = save_mask(str(tmp_path), mask, {"target_sparsity": 0.5})
+    assert meta["mask_crc32"] == mask_checksum(mask)
+    assert meta["sparsity"] == pytest.approx(0.5, abs=0.05)
+    back, back_meta = load_mask(str(tmp_path))
+    assert back_meta["target_sparsity"] == 0.5
+    assert mask_checksum(back) == mask_checksum(mask)
+    for name in MODULES:
+        np.testing.assert_array_equal(
+            np.asarray(back["layer"][name]["kernel"]),
+            np.asarray(mask["layer"][name]["kernel"]),
+        )
+    # an unpruned checkpoint is (None, None), not an error
+    assert load_mask(str(tmp_path / "nowhere")) == (None, None)
+    # a tampered mask fails its recorded crc32
+    import json
+
+    meta_path = tmp_path / "prune_meta.json"
+    doc = json.loads(meta_path.read_text())
+    doc["mask_crc32"] ^= 1
+    meta_path.write_text(json.dumps(doc))
+    with pytest.raises(PruneMaskMismatchError, match="crc32"):
+        load_mask(str(tmp_path))
+
+
+# -- draft checkpoint export --------------------------------------------------
+
+
+def test_export_draft_checkpoint_roundtrip(tmp_path):
+    """Export = serving restore + prune + re-save through the normal writer:
+    the output passes manifest verification, restores through
+    restore_serving_params with the holes intact, and records sparsity +
+    mask checksum in both the manifest metadata and the sidecar."""
+    from relora_tpu.compress.draft import export_draft_checkpoint
+    from relora_tpu.train import checkpoint as ckpt
+
+    params = make_params()
+    src = ckpt.save_checkpoint(
+        str(tmp_path / "src"), 7, {"params": params}, {"update_step": 7}, SPEC
+    )
+    ckpt.wait_for_save()
+
+    out = export_draft_checkpoint(src, str(tmp_path / "draft"), sparsity=0.5)
+    served = ckpt.restore_serving_params(out)  # manifest-verified restore
+    mask, meta = load_mask(out)
+    assert meta["target_sparsity"] == 0.5
+    for name in MODULES:
+        keep = np.asarray(mask["layer"][name]["kernel"])
+        vals = dequant_base(served["layer"][name])
+        assert (vals[~keep] == 0.0).all(), name
+        assert "lora_a" not in served["layer"][name]  # merged tree
+    block = ckpt.load_manifest_metadata(out)["pruned"]
+    assert block["mask_crc32"] == mask_checksum(mask)
+    assert block["sparsity"] == pytest.approx(0.5, abs=0.05)
+    assert block["source_checkpoint"] == os.path.abspath(src)
+
+    # a prune-retrain source carries its own sidecar: the export must reuse
+    # that exact mask (the factors were trained against it), not recompute
+    save_mask(src, mask, {"target_sparsity": 0.5})
+    out2 = export_draft_checkpoint(src, str(tmp_path / "draft2"))
+    assert ckpt.load_manifest_metadata(out2)["pruned"]["mask_crc32"] == mask_checksum(mask)
+
+
+def test_export_draft_requires_mask_or_sparsity(tmp_path):
+    from relora_tpu.compress.draft import export_draft_checkpoint
+    from relora_tpu.train import checkpoint as ckpt
+
+    src = ckpt.save_checkpoint(
+        str(tmp_path / "src"), 1, {"params": make_params()}, {"update_step": 1}, SPEC
+    )
+    ckpt.wait_for_save()
+    with pytest.raises(ValueError, match="no prune_mask.npz"):
+        export_draft_checkpoint(src, str(tmp_path / "out"))
+
+
+# -- model-drafted speculative decoding ---------------------------------------
+
+
+def make_model_spec_engines(cfg, *, sparsity=0.3, cache_size=32, page_size=8, spec_k=4):
+    """(plain engine, spec engine with pruned draft, mask): base = merged
+    LoRA model, draft = the same merge with a magnitude mask applied."""
+    model = build_decode_model(cfg, cache_size=cache_size)
+    lora_model = type(model)(cfg, lora=SPEC, dtype=jnp.float32, scan_layers=True)
+    params = init_params(
+        lora_model, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    mask = magnitude_mask(params, sparsity, scope="per_matrix")
+    base_tree = jax.tree_util.tree_map(np.asarray, merged_params(params, SPEC))
+    draft_tree = jax.tree_util.tree_map(
+        np.asarray, apply_mask(merged_params(params, SPEC), mask)
+    )
+    kw = dict(
+        cache_size=cache_size,
+        page_size=page_size,
+        # model mode doubles the worst-case pages per slot (base + draft)
+        num_pages=4 * (cache_size // page_size) + 1,
+        chunk_size=8,
+    )
+    plain = InferenceEngine(cfg, base_tree, **kw)
+    spec_eng = InferenceEngine(cfg, base_tree, spec_k=spec_k, **kw)
+    spec_eng.load_draft_params(draft_tree)
+    return plain, spec_eng, mask
+
+
+def model_spec_requests(vocab):
+    rng = np.random.default_rng(7)
+    return [
+        Request(uid=1, prompt=[3, 5, 7] * 4, max_new_tokens=8),
+        Request(uid=2, prompt=rng.integers(1, vocab, 13).tolist(), max_new_tokens=6),
+        Request(uid=3, prompt=[2, 4] * 6, max_new_tokens=7, temperature=0.8, top_p=0.9),
+        Request(uid=4, prompt=rng.integers(1, vocab, 5).tolist(), max_new_tokens=5),
+    ]
+
+
+def drain(engine, reqs, **kwargs):
+    sched = PagedContinuousBatchingScheduler(
+        engine, max_batch=2, eos_id=9, key=jax.random.PRNGKey(42), **kwargs
+    )
+    completions = sched.run(reqs)
+    return sched, {uid: c.tokens for uid, c in completions.items()}
+
+
+@pytest.mark.serve
+@pytest.mark.spec
+@pytest.mark.parametrize("cfg", [TINY_LLAMA, TINY_NEOX], ids=["llama", "neox"])
+def test_greedy_model_spec_drain_token_identical(cfg):
+    """Acceptance pin: greedy requests through ``spec='model'`` with a
+    *pruned* draft emit exactly the tokens the non-speculative drain emits —
+    the draft proposes, the base verifies, and ``spec_verify_draws`` math is
+    untouched, so divergent proposals cost acceptance, never parity."""
+    plain, spec_eng, mask = make_model_spec_engines(cfg)
+    reqs = model_spec_requests(cfg.vocab_size)
+    _, want = drain(plain, reqs)
+    sched, got = drain(spec_eng, reqs, spec="model")
+    for uid in (1, 2, 4):  # the greedy rows are token-pinned
+        assert got[uid] == want[uid], f"uid {uid}"
+    assert got[3] and all(0 <= t < cfg.vocab_size for t in got[3])
+    stats = sched.spec_stats()
+    assert stats["mode"] == "model" and stats["k"] == 4
+    assert stats["drafted"] > 0  # the model drafter always proposes
+    assert 0 <= stats["accepted"] <= stats["drafted"]
+    # base AND draft page runs both released at retirement
+    assert sched.allocator.used_pages == 0
+    assert sched.prefix_cache is None or not sched.prefix_cache  # lockstep guard
+
+    # hot-swap invariance: reload the plain engine with the pruned draft
+    # tree — the masked zeros must survive the jitted device swap exactly
+    plain.reload_params(
+        jax.tree_util.tree_map(np.asarray, spec_eng.draft_params)
+    )
+    from relora_tpu.compress.prune import _mask_items, _module_at
+
+    checked = 0
+    for path, keep in _mask_items(mask):
+        mod = _module_at(plain.params, path)
+        if mod is None:
+            continue
+        vals = dequant_base(jax.tree_util.tree_map(np.asarray, mod))
+        assert (vals[~np.asarray(keep)] == 0.0).all(), "/".join(path)
+        checked += 1
+    assert checked > 0
+
+
+@pytest.mark.serve
+@pytest.mark.spec
+def test_identical_draft_accepts_everything():
+    """Degenerate oracle: when the draft IS the base, every greedy proposal
+    matches the base argmax, so acceptance is total and the drain finishes
+    in far fewer decode dispatches than one-per-token."""
+    plain, spec_eng, _ = make_model_spec_engines(TINY_LLAMA, sparsity=0.0)
+    reqs = [
+        Request(uid=1, prompt=[3, 5, 7] * 4, max_new_tokens=8),
+        Request(uid=2, prompt=[2, 4] * 6, max_new_tokens=8),
+    ]
+    _, want = drain(plain, reqs)
+    sched, got = drain(spec_eng, reqs, spec="model")
+    assert got == want
+    stats = sched.spec_stats()
+    assert stats["drafted"] == stats["accepted"] > 0
+    assert stats["accept_rate"] == 1.0
+
+
+@pytest.mark.serve
+@pytest.mark.spec
+def test_model_spec_configuration_guards():
+    plain, spec_eng, _ = make_model_spec_engines(TINY_LLAMA)
+    # no draft installed -> the scheduler refuses up front
+    bare = InferenceEngine(
+        TINY_LLAMA, plain.params, cache_size=32, page_size=8, num_pages=13,
+        chunk_size=8, spec_k=4,
+    )
+    with pytest.raises(ValueError, match="load_draft_params"):
+        PagedContinuousBatchingScheduler(bare, max_batch=2, spec="model")
+    # the draft loop runs on the per-row decode path: packed is out
+    with pytest.raises(ValueError, match="packed"):
+        PagedContinuousBatchingScheduler(
+            spec_eng, max_batch=2, spec="model", packed=True
+        )
+    # disaggregated roles cannot migrate draft KV pages
+    with pytest.raises(ValueError, match="role"):
+        PagedContinuousBatchingScheduler(
+            spec_eng, max_batch=2, spec="model", role="decode"
+        )
+    # prefix cache is force-disabled (base/draft prefill lockstep)
+    sched = PagedContinuousBatchingScheduler(
+        spec_eng, max_batch=2, spec="model", prefix_cache=True
+    )
+    assert sched.prefix_cache is None or not sched.prefix_cache
+
+
+# -- training-config dials ----------------------------------------------------
+
+
+def test_training_config_prune_validation():
+    from relora_tpu.config.training import TrainingConfig
+
+    def cfg(**kw):
+        return TrainingConfig(dataset_path="/tmp/ds", batch_size=4, **kw)
+
+    with pytest.raises(ValueError, match="use_peft"):
+        cfg(prune_sparsity=0.5).finalize()
+    with pytest.raises(ValueError, match="prune_sparsity"):
+        cfg(use_peft=True, prune_sparsity=1.5).finalize()
+    with pytest.raises(ValueError, match="prune_scope"):
+        cfg(use_peft=True, prune_sparsity=0.5, prune_scope="everywhere").finalize()
+    with pytest.raises(ValueError, match="N:M"):
+        cfg(use_peft=True, prune_nm="4:2").finalize()
+    with pytest.raises(ValueError, match="reset_init"):
+        cfg(reset_init="xavier").finalize()
+    ok = cfg(use_peft=True, prune_sparsity=0.5, reset_init="magnitude").finalize()
+    assert ok.prune_enabled
+    assert not cfg(use_peft=True).finalize().prune_enabled

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Thresholded regression gate over the committed BENCH_* trajectory.
 
-Eleven rules, each skipped gracefully when its input files are absent:
+Twelve rules, each skipped gracefully when its input files are absent:
 
 1. **train tok/s** (``BENCH_r*.json``): the latest round with a real
    measurement (``parsed.value > 0`` — watchdog rounds report 0 and are
@@ -60,6 +60,15 @@ Eleven rules, each skipped gracefully when its input files are absent:
    int8 arm's migrated bytes must be at most 0.3x the bf16 arm's — the
    quantized page payload is the whole point of migrating int8 pools.
    Structural — counts and parity, not time — so it runs everywhere.
+12. **compression** (``BENCH_compress.json``): the prune-retrain ladder must
+   cover at least the committed ``compress.min_levels`` sparsity levels, every
+   level must report its GLUE score and draft accept rate, greedy ``--spec
+   model`` output must be token-identical to the non-speculative run at every
+   sparsity level, and the lightest level's accept rate must clear
+   ``compress.accept_rate_floor`` — a near-dense draft that stops agreeing
+   with its own base means the draft KV lockstep or the verify walk broke.
+   Structural (parity, counts, deterministic greedy accept math — not wall
+   time), so it runs everywhere, off-TPU included.
 
 Exit codes: 0 = all rules pass (or skipped), 1 = regression, 2 = usage error.
 ``--warn-only`` reports failures but exits 0 — CI uses it off-TPU where the
@@ -319,6 +328,69 @@ def check_spec(
     return failures
 
 
+def check_compress(bench_dir: str, baselines: Optional[Dict[str, Any]]) -> List[str]:
+    """Compression rules over BENCH_compress.json (``bench.py --mode
+    compress`` — the prune-retrain ladder from relora_tpu/compress):
+
+    - the ladder must cover at least ``compress.min_levels`` sparsity levels
+      (default 3) — one point is a smoke test, not a quality curve;
+    - every level must report a numeric ``glue_score`` and draft
+      ``accept_rate`` — a level that silently dropped either half measured
+      nothing;
+    - greedy ``--spec model`` output must be token-identical to the
+      non-speculative run at **every** sparsity level — parity is
+      architecture math (``spec_verify_draws`` with temperature 0), so any
+      divergence means the draft KV lockstep or the verify/accept walk
+      broke, never noise;
+    - the lightest level's accept rate must clear
+      ``compress.accept_rate_floor`` — with the default ladder the lightest
+      draft is the unpruned merge of the same weights, so its acceptance is
+      near-total by construction and a collapse is a wiring bug.
+
+    Everything here is structural (parity, counts, deterministic greedy
+    accept math — not wall time), so unlike ``check_spec`` the rule runs
+    off-TPU too.
+    """
+    doc = _load(os.path.join(bench_dir, "BENCH_compress.json"))
+    detail = (doc or {}).get("detail") or {}
+    levels = detail.get("levels") or []
+    if not levels:
+        return []
+    caps = (baselines or {}).get("compress") or {}
+    failures = []
+    min_levels = int(caps.get("min_levels", 3))
+    if len(levels) < min_levels:
+        failures.append(
+            f"compress: only {len(levels)} sparsity level(s) measured — the "
+            f"ladder needs at least {min_levels} to be a quality curve"
+        )
+    for lv in levels:
+        tag = f"compress s={lv.get('sparsity')}"
+        spec = lv.get("spec") or {}
+        if not isinstance(lv.get("glue_score"), (int, float)):
+            failures.append(f"{tag}: missing glue_score — the quality half of the ladder")
+        if not isinstance(spec.get("accept_rate"), (int, float)):
+            failures.append(f"{tag}: missing draft accept_rate — the serving half of the ladder")
+        if spec.get("token_parity") is False:
+            failures.append(
+                f"{tag}: greedy --spec model output diverged from the "
+                "non-speculative run — parity is exact math at temperature 0, "
+                "so the draft KV lockstep or the verify walk is broken"
+            )
+    lightest = min(levels, key=lambda lv: lv.get("sparsity", 1.0))
+    floor = float(caps.get("accept_rate_floor", 0.0))
+    lspec = lightest.get("spec") or {}
+    rate = lspec.get("accept_rate")
+    if lspec.get("drafted", 0) and isinstance(rate, (int, float)) and rate < floor:
+        failures.append(
+            f"compress s={lightest.get('sparsity')}: accept rate {rate:.3f} "
+            f"below floor {floor:.3f} on the lightest draft "
+            f"({lspec.get('accepted', 0)}/{lspec.get('drafted', 0)} drafted "
+            "tokens accepted) — a near-dense draft should track its base"
+        )
+    return failures
+
+
 def check_packed(bench_dir: str, tolerance: float) -> List[str]:
     """Packed-step rule over ``detail.packed_run`` in BENCH_http.json
     (present for paged ``--mode serve_load`` runs unless
@@ -538,6 +610,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         + check_autoscale(args.dir)
         + check_grouped_lora(args.dir, args.tolerance)
         + check_disagg(args.dir)
+        + check_compress(args.dir, baselines)
     )
 
     rounds = real_rounds(args.dir)

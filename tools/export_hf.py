@@ -31,6 +31,14 @@ def main(argv=None):
         default="f32",
         help="storage dtype of the exported tensors (merge math stays f32)",
     )
+    p.add_argument(
+        "--pruned",
+        action="store_true",
+        help="apply the checkpoint's prune_mask.npz sidecar to the merged "
+        "tree before export (pruned positions stay exactly zero) and record "
+        "sparsity + mask checksum in the output config; errors if the "
+        "checkpoint has no mask or the mask does not fit the tree",
+    )
     args = p.parse_args(argv)
 
     sys.path.insert(0, ".")
@@ -50,6 +58,32 @@ def main(argv=None):
     if spec is not None:
         params = jax.tree_util.tree_map(np.asarray, merged_params(params, spec))
         print(f"merged LoRA factors (r={spec.r}) into base weights")
+
+    pruning_block = None
+    if args.pruned:
+        # apply_mask raises PruneMaskMismatchError (naming the module) on a
+        # missing module or shape mismatch — a wrong-architecture mask must
+        # fail the export, not silently ship dense weights
+        from relora_tpu.compress.prune import (
+            apply_mask,
+            load_mask,
+            mask_checksum,
+            sparsity_stats,
+        )
+
+        mask, _ = load_mask(args.checkpoint)
+        if mask is None:
+            raise SystemExit(
+                f"--pruned: {args.checkpoint} has no prune_mask.npz sidecar "
+                "(not a prune-retrain checkpoint?)"
+            )
+        params = jax.tree_util.tree_map(np.asarray, apply_mask(params, mask))
+        stats = sparsity_stats(mask)
+        pruning_block = {
+            "sparsity": round(stats["sparsity"], 6),
+            "mask_crc32": mask_checksum(mask),
+        }
+        print(f"applied prune mask: {stats['sparsity']:.1%} sparsity")
 
     sd = params_to_hf(params, cfg)
     os.makedirs(args.out, exist_ok=True)
@@ -86,6 +120,8 @@ def main(argv=None):
         "eos_token_id": cfg.eos_token_id,
         "torch_dtype": "bfloat16" if args.dtype == "bf16" else "float32",
     }
+    if pruning_block is not None:
+        hf_config["relora_tpu_pruning"] = pruning_block
     with open(os.path.join(args.out, "config.json"), "w") as f:
         json.dump(hf_config, f, indent=2)
     n = sum(v.size for v in sd.values())

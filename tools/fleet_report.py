@@ -110,6 +110,20 @@ def replica_comparison(
         for header, series, scale, fmt in _COMPARE_COLUMNS:
             m = _mean(store.window_values(src, series, window_s, now=now))
             cells[header] = "-" if m is None else fmt.format(m * scale)
+            if header == "spec_acc" and m is not None:
+                # an 0.2 accept rate is healthy for ngram and a collapse for
+                # a model draft — the mode suffix keeps the column comparable
+                mode = _mean(
+                    store.window_values(src, "spec_mode_model", window_s, now=now)
+                )
+                if mode is None:
+                    mode = _mean(
+                        store.window_values(
+                            src, "relora_serve_spec_mode_model", window_s, now=now
+                        )
+                    )
+                if mode is not None:
+                    cells[header] += ":mdl" if mode >= 0.5 else ":ngm"
         if any(v != "-" for v in cells.values()):
             rows.append((src, cells))
     if not rows:
